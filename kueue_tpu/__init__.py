@@ -1,0 +1,11 @@
+"""kueue_tpu: a TPU-native job-queueing framework with the capabilities of Kueue.
+
+Quota-based admission of gang workloads across hierarchical cohorts of
+ClusterQueues with resource flavors, borrowing/lending, priority and
+fair-share (DRF) preemption, two-phase admission checks, topology-aware
+placement and multi-cluster dispatch.  The per-cycle admission core runs as
+a batched JAX/XLA solver (see kueue_tpu.ops) driven by a thin control plane
+that mirrors the reference's cache/queue/event semantics.
+"""
+
+__version__ = "0.1.0"

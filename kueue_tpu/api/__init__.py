@@ -1,0 +1,3 @@
+from . import quantity, types  # noqa: F401
+from .types import *  # noqa: F401,F403
+from .quantity import parse_quantity, format_milli  # noqa: F401

@@ -1,0 +1,90 @@
+"""Kubernetes-style resource quantity parsing.
+
+Capability parity with k8s.io/apimachinery resource.Quantity as used by the
+reference (Kueue stores quantities as int64 milli-units for cpu and plain
+units for everything else; see reference pkg/resources/requests.go).
+
+We normalise every quantity to an integer number of *milli-units* so that
+"250m" cpu == 250 and "1" cpu == 1000.  For non-cpu resources Kueue uses
+whole units (bytes for memory); we keep the same convention via
+``parse_quantity(value, milli=False)``.
+"""
+
+from __future__ import annotations
+
+import re
+from fractions import Fraction
+
+_BINARY_SUFFIXES = {
+    "Ki": 1024,
+    "Mi": 1024**2,
+    "Gi": 1024**3,
+    "Ti": 1024**4,
+    "Pi": 1024**5,
+    "Ei": 1024**6,
+}
+
+_DECIMAL_SUFFIXES = {
+    "n": Fraction(1, 10**9),
+    "u": Fraction(1, 10**6),
+    "m": Fraction(1, 10**3),
+    "": Fraction(1),
+    "k": 10**3,
+    "M": 10**6,
+    "G": 10**9,
+    "T": 10**12,
+    "P": 10**15,
+    "E": 10**18,
+}
+
+_QUANTITY_RE = re.compile(
+    r"^(?P<sign>[+-]?)(?P<num>\d+(?:\.\d*)?|\.\d+)"
+    r"(?:[eE](?P<exp>[+-]?\d+))?"
+    r"(?P<suffix>Ki|Mi|Gi|Ti|Pi|Ei|[numkMGTPE]?)$"
+)
+
+
+def parse_quantity(value: int | float | str, *, milli: bool = True) -> int:
+    """Parse a k8s quantity into integer units.
+
+    With ``milli=True`` (default) the result is in milli-units (cpu
+    convention); with ``milli=False`` the result is in whole units rounded
+    up (memory/pods convention, matching resource.Quantity.Value()).
+    """
+    if isinstance(value, bool):  # bool is an int subclass; reject it
+        raise ValueError(f"invalid quantity: {value!r}")
+    if isinstance(value, int):
+        frac = Fraction(value)
+    elif isinstance(value, float):
+        frac = Fraction(value).limit_denominator(10**9)
+    else:
+        text = value.strip()
+        m = _QUANTITY_RE.match(text)
+        if not m:
+            raise ValueError(f"invalid quantity: {value!r}")
+        num = Fraction(m.group("num"))
+        if m.group("exp"):
+            exp = int(m.group("exp"))
+            num *= Fraction(10) ** exp
+        suffix = m.group("suffix")
+        if suffix in _BINARY_SUFFIXES:
+            num *= _BINARY_SUFFIXES[suffix]
+        else:
+            num *= _DECIMAL_SUFFIXES[suffix]
+        if m.group("sign") == "-":
+            num = -num
+        frac = num
+    if milli:
+        frac *= 1000
+    # k8s rounds up to the smallest representable unit (Quantity.Value()).
+    num, den = frac.numerator, frac.denominator
+    if den == 1:
+        return num
+    return -((-num) // den) if num >= 0 else num // den
+
+
+def format_milli(milli_value: int) -> str:
+    """Render a milli-unit quantity the way `kubectl` would (e.g. 1500 -> "1500m")."""
+    if milli_value % 1000 == 0:
+        return str(milli_value // 1000)
+    return f"{milli_value}m"

@@ -1,0 +1,511 @@
+"""Domain model: the CRD-equivalent API types.
+
+Capability parity with the reference's apis/kueue/v1beta1 (workload_types.go,
+clusterqueue_types.go, localqueue_types.go, resourceflavor_types.go,
+admissioncheck_types.go, workloadpriorityclass_types.go) and
+apis/kueue/v1alpha1 (cohort_types.go, tas_types.go).  These are plain Python
+dataclasses — the durable-state story is different from Kubernetes CRDs (see
+kueue_tpu.controller.store), but field semantics are kept 1:1 so that the
+reference's scenarios translate directly.
+"""
+
+from __future__ import annotations
+
+import enum
+from dataclasses import dataclass, field
+from typing import Any, Optional
+
+from .quantity import parse_quantity
+
+# ---------------------------------------------------------------------------
+# Shared small types
+# ---------------------------------------------------------------------------
+
+ResourceName = str  # "cpu", "memory", "nvidia.com/gpu", "google.com/tpu", ...
+
+#: Resources accounted in milli-units (reference: pkg/resources treats cpu
+#: via MilliValue, everything else via Value).
+MILLI_RESOURCES = frozenset({"cpu"})
+
+
+def quantity_to_int(resource: ResourceName, value: int | float | str) -> int:
+    return parse_quantity(value, milli=resource in MILLI_RESOURCES)
+
+
+class ConditionStatus(str, enum.Enum):
+    TRUE = "True"
+    FALSE = "False"
+    UNKNOWN = "Unknown"
+
+
+@dataclass
+class Condition:
+    type: str
+    status: ConditionStatus
+    reason: str = ""
+    message: str = ""
+    last_transition_time: float = 0.0
+    observed_generation: int = 0
+
+
+@dataclass(frozen=True)
+class Toleration:
+    key: str = ""
+    operator: str = "Equal"  # Equal | Exists
+    value: str = ""
+    effect: str = ""  # "" matches all effects
+    toleration_seconds: Optional[int] = None
+
+
+@dataclass(frozen=True)
+class Taint:
+    key: str
+    value: str = ""
+    effect: str = "NoSchedule"  # NoSchedule | PreferNoSchedule | NoExecute
+
+
+def toleration_tolerates(tol: Toleration, taint: Taint) -> bool:
+    """Reference semantics: k8s.io/api core/v1 Toleration.ToleratesTaint."""
+    if tol.effect and tol.effect != taint.effect:
+        return False
+    if tol.key and tol.key != taint.key:
+        return False
+    if tol.operator == "Exists":
+        return True
+    return tol.value == taint.value
+
+
+def taints_tolerated(taints: list[Taint], tolerations: list[Toleration],
+                     *, include_prefer: bool = False) -> bool:
+    """True when every NoSchedule/NoExecute taint is tolerated.
+
+    PreferNoSchedule taints never block admission (matching the scheduling
+    corev1helpers.FindMatchingUntoleratedTaint filter used by the
+    flavorassigner, reference pkg/scheduler/flavorassigner/flavorassigner.go:662).
+    """
+    for taint in taints:
+        if taint.effect == "PreferNoSchedule" and not include_prefer:
+            continue
+        if not any(toleration_tolerates(t, taint) for t in tolerations):
+            return False
+    return True
+
+
+# ---------------------------------------------------------------------------
+# ResourceFlavor (reference: resourceflavor_types.go:31)
+# ---------------------------------------------------------------------------
+
+@dataclass
+class ResourceFlavor:
+    name: str
+    node_labels: dict[str, str] = field(default_factory=dict)
+    node_taints: list[Taint] = field(default_factory=list)
+    tolerations: list[Toleration] = field(default_factory=list)
+    topology_name: Optional[str] = None  # TAS binding
+
+
+# ---------------------------------------------------------------------------
+# Quota model (reference: clusterqueue_types.go:169-252)
+# ---------------------------------------------------------------------------
+
+@dataclass
+class ResourceQuota:
+    """Per (flavor, resource) quota. Values in canonical integer units."""
+    nominal: int = 0
+    borrowing_limit: Optional[int] = None  # None = unlimited borrowing
+    lending_limit: Optional[int] = None    # None = lend everything
+
+    @staticmethod
+    def make(resource: ResourceName, nominal: int | float | str,
+             borrowing_limit: int | float | str | None = None,
+             lending_limit: int | float | str | None = None) -> "ResourceQuota":
+        return ResourceQuota(
+            nominal=quantity_to_int(resource, nominal),
+            borrowing_limit=None if borrowing_limit is None
+            else quantity_to_int(resource, borrowing_limit),
+            lending_limit=None if lending_limit is None
+            else quantity_to_int(resource, lending_limit),
+        )
+
+
+@dataclass
+class FlavorQuotas:
+    name: str  # flavor name
+    resources: dict[ResourceName, ResourceQuota] = field(default_factory=dict)
+
+
+@dataclass
+class ResourceGroup:
+    covered_resources: list[ResourceName]
+    flavors: list[FlavorQuotas]
+
+
+# ---------------------------------------------------------------------------
+# Preemption / fungibility policies (reference: clusterqueue_types.go:336-511)
+# ---------------------------------------------------------------------------
+
+class QueueingStrategy(str, enum.Enum):
+    STRICT_FIFO = "StrictFIFO"
+    BEST_EFFORT_FIFO = "BestEffortFIFO"
+
+
+class ReclaimWithinCohort(str, enum.Enum):
+    NEVER = "Never"
+    LOWER_PRIORITY = "LowerPriority"
+    ANY = "Any"
+
+
+class WithinClusterQueue(str, enum.Enum):
+    NEVER = "Never"
+    LOWER_PRIORITY = "LowerPriority"
+    LOWER_OR_NEWER_EQUAL_PRIORITY = "LowerOrNewerEqualPriority"
+
+
+class BorrowWithinCohortPolicy(str, enum.Enum):
+    NEVER = "Never"
+    LOWER_PRIORITY = "LowerPriority"
+
+
+@dataclass
+class BorrowWithinCohort:
+    policy: BorrowWithinCohortPolicy = BorrowWithinCohortPolicy.NEVER
+    max_priority_threshold: Optional[int] = None
+
+
+@dataclass
+class PreemptionPolicy:
+    reclaim_within_cohort: ReclaimWithinCohort = ReclaimWithinCohort.NEVER
+    borrow_within_cohort: BorrowWithinCohort = field(default_factory=BorrowWithinCohort)
+    within_cluster_queue: WithinClusterQueue = WithinClusterQueue.NEVER
+
+
+class FlavorFungibilityPolicy(str, enum.Enum):
+    BORROW = "Borrow"
+    PREEMPT = "Preempt"
+    TRY_NEXT_FLAVOR = "TryNextFlavor"
+
+
+@dataclass
+class FlavorFungibility:
+    when_can_borrow: FlavorFungibilityPolicy = FlavorFungibilityPolicy.BORROW
+    when_can_preempt: FlavorFungibilityPolicy = FlavorFungibilityPolicy.TRY_NEXT_FLAVOR
+
+
+class StopPolicy(str, enum.Enum):
+    NONE = "None"
+    HOLD = "Hold"
+    HOLD_AND_DRAIN = "HoldAndDrain"
+
+
+@dataclass
+class FairSharing:
+    weight: float = 1.0  # FairSharing.weight, default 1 (fairsharing_types.go:27)
+
+
+# ---------------------------------------------------------------------------
+# AdmissionChecks (reference: admissioncheck_types.go, KEP 993)
+# ---------------------------------------------------------------------------
+
+class AdmissionCheckState(str, enum.Enum):
+    PENDING = "Pending"
+    READY = "Ready"
+    RETRY = "Retry"
+    REJECTED = "Rejected"
+
+
+@dataclass
+class AdmissionCheck:
+    name: str
+    controller_name: str = ""
+    parameters: Optional[dict[str, Any]] = None
+    active: bool = True
+
+
+@dataclass
+class AdmissionCheckStrategyRule:
+    name: str
+    on_flavors: list[str] = field(default_factory=list)  # empty = all flavors
+
+
+# ---------------------------------------------------------------------------
+# ClusterQueue (reference: clusterqueue_types.go:511)
+# ---------------------------------------------------------------------------
+
+@dataclass
+class ClusterQueue:
+    name: str
+    resource_groups: list[ResourceGroup] = field(default_factory=list)
+    cohort: Optional[str] = None
+    queueing_strategy: QueueingStrategy = QueueingStrategy.BEST_EFFORT_FIFO
+    preemption: PreemptionPolicy = field(default_factory=PreemptionPolicy)
+    flavor_fungibility: FlavorFungibility = field(default_factory=FlavorFungibility)
+    admission_checks: list[str] = field(default_factory=list)
+    admission_checks_strategy: list[AdmissionCheckStrategyRule] = field(default_factory=list)
+    fair_sharing: Optional[FairSharing] = None
+    stop_policy: StopPolicy = StopPolicy.NONE
+    namespace_selector: Optional[dict[str, str]] = None  # None = match nothing? (ref: nil matches nothing; {} matches all)
+
+    def flavor_resources(self) -> list[tuple[str, ResourceName]]:
+        out = []
+        for rg in self.resource_groups:
+            for fq in rg.flavors:
+                for rname in fq.resources:
+                    out.append((fq.name, rname))
+        return out
+
+
+# ---------------------------------------------------------------------------
+# Cohort (reference: v1alpha1 cohort_types.go:85, KEP 79)
+# ---------------------------------------------------------------------------
+
+@dataclass
+class Cohort:
+    name: str
+    parent_name: Optional[str] = None
+    resource_groups: list[ResourceGroup] = field(default_factory=list)
+    fair_sharing: Optional[FairSharing] = None
+
+
+# ---------------------------------------------------------------------------
+# LocalQueue (reference: localqueue_types.go:187)
+# ---------------------------------------------------------------------------
+
+@dataclass
+class LocalQueue:
+    name: str
+    namespace: str = "default"
+    cluster_queue: str = ""
+    stop_policy: StopPolicy = StopPolicy.NONE
+
+    @property
+    def key(self) -> str:
+        return f"{self.namespace}/{self.name}"
+
+
+# ---------------------------------------------------------------------------
+# Topology (reference: v1alpha1 tas_types.go, KEP 2724)
+# ---------------------------------------------------------------------------
+
+@dataclass
+class Topology:
+    name: str
+    levels: list[str] = field(default_factory=list)  # ordered node-label keys, top→bottom
+
+
+@dataclass
+class PodSetTopologyRequest:
+    required: Optional[str] = None     # level label that must contain all pods
+    preferred: Optional[str] = None    # level label to try first, fall back upward
+    unconstrained: bool = False
+    pod_index_label: Optional[str] = None
+    slice_required_topology: Optional[str] = None
+    slice_size: Optional[int] = None
+
+
+@dataclass
+class TopologyDomainAssignment:
+    values: list[str]  # node-label values along topology levels
+    count: int
+
+
+@dataclass
+class TopologyAssignment:
+    levels: list[str]
+    domains: list[TopologyDomainAssignment] = field(default_factory=list)
+
+
+# ---------------------------------------------------------------------------
+# Workload (reference: workload_types.go:639)
+# ---------------------------------------------------------------------------
+
+DEFAULT_POD_SET_NAME = "main"
+
+
+@dataclass
+class PodSet:
+    """One homogeneous group of pods (reference workload_types.go:262)."""
+    name: str = DEFAULT_POD_SET_NAME
+    count: int = 1
+    min_count: Optional[int] = None  # partial admission (KEP 420)
+    # per-pod resource requests in canonical integer units
+    requests: dict[ResourceName, int] = field(default_factory=dict)
+    node_selector: dict[str, str] = field(default_factory=dict)
+    tolerations: list[Toleration] = field(default_factory=list)
+    labels: dict[str, str] = field(default_factory=dict)
+    annotations: dict[str, str] = field(default_factory=dict)
+    scheduling_gates: list[str] = field(default_factory=list)
+    required_node_affinity: dict[str, list[str]] = field(default_factory=dict)
+    topology_request: Optional[PodSetTopologyRequest] = None
+
+    @staticmethod
+    def make(name: str = DEFAULT_POD_SET_NAME, count: int = 1,
+             requests: dict[ResourceName, int | float | str] | None = None,
+             **kw) -> "PodSet":
+        reqs = {r: quantity_to_int(r, v) for r, v in (requests or {}).items()}
+        return PodSet(name=name, count=count, requests=reqs, **kw)
+
+
+@dataclass
+class PodSetAssignment:
+    """Admission decision for one PodSet (reference workload_types.go:151)."""
+    name: str
+    flavors: dict[ResourceName, str] = field(default_factory=dict)
+    resource_usage: dict[ResourceName, int] = field(default_factory=dict)
+    count: int = 0
+    topology_assignment: Optional[TopologyAssignment] = None
+    delayed_topology_request: Optional[str] = None
+
+
+@dataclass
+class Admission:
+    cluster_queue: str
+    pod_set_assignments: list[PodSetAssignment] = field(default_factory=list)
+
+
+@dataclass
+class AdmissionCheckStatus:
+    name: str
+    state: AdmissionCheckState = AdmissionCheckState.PENDING
+    message: str = ""
+    last_transition_time: float = 0.0
+    pod_set_updates: list[dict[str, Any]] = field(default_factory=list)
+
+
+@dataclass
+class RequeueState:
+    """Eviction-requeue backoff (reference workload_types.go:372)."""
+    count: int = 0
+    requeue_at: Optional[float] = None
+
+
+@dataclass
+class ReclaimablePod:
+    name: str  # PodSet name
+    count: int  # number of pods no longer needing resources
+
+
+# Workload condition types (reference pkg/workload + workload_types.go)
+WL_QUOTA_RESERVED = "QuotaReserved"
+WL_ADMITTED = "Admitted"
+WL_FINISHED = "Finished"
+WL_EVICTED = "Evicted"
+WL_PREEMPTED = "Preempted"
+WL_REQUEUED = "Requeued"
+WL_DEACTIVATION_TARGET = "DeactivationTarget"
+
+# Eviction reasons
+EVICTED_BY_PREEMPTION = "Preempted"
+EVICTED_BY_PODS_READY_TIMEOUT = "PodsReadyTimeout"
+EVICTED_BY_ADMISSION_CHECK = "AdmissionCheck"
+EVICTED_BY_CQ_STOPPED = "ClusterQueueStopped"
+EVICTED_BY_LQ_STOPPED = "LocalQueueStopped"
+EVICTED_BY_DEACTIVATION = "InactiveWorkload"
+EVICTED_BY_NODE_FAILURES = "NodeFailures"
+
+# Preemption reasons (reference pkg/scheduler/preemption/preemption.go)
+IN_CLUSTER_QUEUE_REASON = "InClusterQueue"
+IN_COHORT_RECLAMATION_REASON = "InCohortReclamation"
+IN_COHORT_FAIR_SHARING_REASON = "InCohortFairSharing"
+IN_COHORT_RECLAIM_WHILE_BORROWING_REASON = "InCohortReclaimWhileBorrowing"
+
+
+@dataclass
+class Workload:
+    name: str
+    namespace: str = "default"
+    queue_name: str = ""
+    pod_sets: list[PodSet] = field(default_factory=list)
+    priority: int = 0
+    priority_class_name: str = ""
+    priority_class_source: str = ""  # "kueue.x-k8s.io/workloadpriorityclass" or pod PC
+    active: bool = True
+    creation_time: float = 0.0
+    maximum_execution_time_seconds: Optional[int] = None
+
+    # --- status ---
+    admission: Optional[Admission] = None
+    conditions: dict[str, Condition] = field(default_factory=dict)
+    admission_check_states: dict[str, AdmissionCheckStatus] = field(default_factory=dict)
+    requeue_state: Optional[RequeueState] = None
+    reclaimable_pods: list[ReclaimablePod] = field(default_factory=list)
+    scheduling_stats_evictions: dict[str, int] = field(default_factory=dict)
+    generation: int = 1
+    uid: str = ""
+
+    def __post_init__(self):
+        if not self.uid:
+            self.uid = f"{self.namespace}/{self.name}"
+
+    @property
+    def key(self) -> str:
+        return f"{self.namespace}/{self.name}"
+
+    # -- condition helpers (reference pkg/workload/workload.go:774-789) --
+    def condition_true(self, cond_type: str) -> bool:
+        c = self.conditions.get(cond_type)
+        return c is not None and c.status == ConditionStatus.TRUE
+
+    @property
+    def has_quota_reservation(self) -> bool:
+        return self.admission is not None and self.condition_true(WL_QUOTA_RESERVED)
+
+    @property
+    def is_admitted(self) -> bool:
+        return self.condition_true(WL_ADMITTED)
+
+    @property
+    def is_finished(self) -> bool:
+        return self.condition_true(WL_FINISHED)
+
+    @property
+    def is_evicted(self) -> bool:
+        return self.condition_true(WL_EVICTED)
+
+    @property
+    def is_active(self) -> bool:
+        return self.active
+
+    def set_condition(self, cond_type: str, status: ConditionStatus,
+                      reason: str = "", message: str = "", now: float = 0.0) -> None:
+        prev = self.conditions.get(cond_type)
+        if prev is not None and prev.status == status and prev.reason == reason:
+            return
+        self.conditions[cond_type] = Condition(
+            type=cond_type, status=status, reason=reason, message=message,
+            last_transition_time=now, observed_generation=self.generation)
+
+    def clone(self) -> "Workload":
+        import copy
+        return copy.deepcopy(self)
+
+
+@dataclass
+class WorkloadPriorityClass:
+    name: str
+    value: int = 0
+    description: str = ""
+
+
+# ---------------------------------------------------------------------------
+# MultiKueue (reference: multikueue_types.go)
+# ---------------------------------------------------------------------------
+
+@dataclass
+class MultiKueueCluster:
+    name: str
+    kubeconfig_ref: str = ""  # opaque connection handle for the transport layer
+    active: bool = True
+
+
+@dataclass
+class MultiKueueConfig:
+    name: str
+    clusters: list[str] = field(default_factory=list)
+
+
+__all__ = [
+    name for name, value in list(globals().items())
+    if not name.startswith("_")
+    and (getattr(value, "__module__", None) == __name__  # classes/functions here
+         or isinstance(value, (str, frozenset)))          # constants
+]

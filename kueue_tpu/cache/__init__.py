@@ -1,0 +1,5 @@
+from .cache import Cache  # noqa: F401
+from .snapshot import Snapshot  # noqa: F401
+from .state import CohortState, CQState, dominant_resource_share  # noqa: F401
+from .tas_cache import NodeInfo, TASCache  # noqa: F401
+from .tas_snapshot import TASFlavorSnapshot  # noqa: F401

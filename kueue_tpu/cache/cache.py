@@ -1,0 +1,286 @@
+"""The live cache of admitted state, rebuilt from the event stream.
+
+Capability parity with reference pkg/cache/cache.go:102: holds the cohort
+forest of ClusterQueues, resource flavors, admission checks and admitted
+workloads; supports optimistic ``assume_workload``/``forget_workload``
+(cache.go:610,636) ahead of the durable write; produces per-cycle
+snapshots (snapshot.py).
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Optional
+
+from .. import hierarchy
+from ..api.types import (
+    Admission,
+    AdmissionCheck,
+    ClusterQueue,
+    Cohort,
+    LocalQueue,
+    ResourceFlavor,
+    StopPolicy,
+    Topology,
+)
+from ..resources import FlavorResourceQuantities
+from ..workload import Info, InfoOptions
+from .snapshot import Snapshot
+from .state import (
+    CohortState,
+    CQState,
+    build_quotas,
+    update_cluster_queue_resource_node,
+    update_cohort_resource_node,
+)
+from .tas_cache import TASCache
+
+
+class Cache:
+    def __init__(self, info_options: InfoOptions | None = None,
+                 fair_sharing_enabled: bool = False):
+        self._lock = threading.RLock()
+        self._mgr: hierarchy.Manager[CQState, CohortState] = hierarchy.Manager(CohortState)
+        self.resource_flavors: dict[str, ResourceFlavor] = {}
+        self.admission_checks: dict[str, AdmissionCheck] = {}
+        self.local_queues: dict[str, LocalQueue] = {}
+        self.assumed_workloads: set[str] = set()
+        self.info_options = info_options or InfoOptions()
+        self.fair_sharing_enabled = fair_sharing_enabled
+        self.tas = TASCache()
+
+    # ------------------------------------------------------------------
+    # ClusterQueues / Cohorts
+    # ------------------------------------------------------------------
+
+    def add_or_update_cluster_queue(self, spec: ClusterQueue) -> None:
+        with self._lock:
+            existing = self._mgr.cluster_queues.get(spec.name)
+            if existing is None:
+                self._mgr.add_cluster_queue(spec.name, CQState(spec))
+            else:
+                existing.update_quotas(spec)
+            self._mgr.update_cluster_queue_edge(spec.name, spec.cohort)
+            self._rebuild()
+
+    def delete_cluster_queue(self, name: str) -> None:
+        with self._lock:
+            self._mgr.delete_cluster_queue(name)
+            self._rebuild()
+
+    def add_or_update_cohort(self, spec: Cohort) -> None:
+        with self._lock:
+            node = self._mgr.add_cohort(spec.name)
+            node.payload.spec = spec
+            node.payload.resource_node.quotas = build_quotas(spec.resource_groups)
+            node.payload.fair_weight_milli = int(
+                (spec.fair_sharing.weight if spec.fair_sharing else 1.0) * 1000)
+            self._mgr.update_cohort_edge(spec.name, spec.parent_name)
+            self._rebuild()
+
+    def delete_cohort(self, name: str) -> None:
+        with self._lock:
+            self._mgr.delete_cohort(name)
+            self._rebuild()
+
+    # ------------------------------------------------------------------
+    # Flavors / checks / local queues / topologies
+    # ------------------------------------------------------------------
+
+    def add_or_update_resource_flavor(self, flavor: ResourceFlavor) -> None:
+        with self._lock:
+            self.resource_flavors[flavor.name] = flavor
+            if flavor.topology_name:
+                self.tas.bind_flavor(flavor)
+            self._update_all_statuses()
+
+    def delete_resource_flavor(self, name: str) -> None:
+        with self._lock:
+            self.resource_flavors.pop(name, None)
+            self.tas.unbind_flavor(name)
+            self._update_all_statuses()
+
+    def add_or_update_admission_check(self, check: AdmissionCheck) -> None:
+        with self._lock:
+            self.admission_checks[check.name] = check
+            self._update_all_statuses()
+
+    def delete_admission_check(self, name: str) -> None:
+        with self._lock:
+            self.admission_checks.pop(name, None)
+            self._update_all_statuses()
+
+    def add_or_update_local_queue(self, lq: LocalQueue) -> None:
+        with self._lock:
+            self.local_queues[lq.key] = lq
+
+    def delete_local_queue(self, lq_key: str) -> None:
+        with self._lock:
+            self.local_queues.pop(lq_key, None)
+
+    def add_or_update_topology(self, topology: Topology) -> None:
+        with self._lock:
+            self.tas.add_topology(topology)
+
+    def delete_topology(self, name: str) -> None:
+        with self._lock:
+            self.tas.delete_topology(name)
+
+    # ------------------------------------------------------------------
+    # Workloads (admitted / assumed) — reference cache.go:536-658
+    # ------------------------------------------------------------------
+
+    def cluster_queue(self, name: str) -> Optional[CQState]:
+        return self._mgr.cluster_queues.get(name)
+
+    def add_or_update_workload(self, info: Info) -> bool:
+        with self._lock:
+            if info.obj.admission is None:
+                return False
+            # Remove any previous accounting first — the workload may have
+            # been re-admitted to a different CQ (reference cache.go
+            # UpdateWorkload removes from the old CQ before adding).
+            owner = self._find_owner(info)
+            if owner is not None:
+                owner.remove_workload(owner.workloads[info.key])
+            cq = self._mgr.cluster_queues.get(info.obj.admission.cluster_queue)
+            if cq is None:
+                self.assumed_workloads.discard(info.key)
+                return False
+            info.cluster_queue = cq.name
+            cq.add_workload(info)
+            self.assumed_workloads.discard(info.key)
+            return True
+
+    def delete_workload(self, info: Info) -> None:
+        with self._lock:
+            cq = self._find_owner(info)
+            if cq is not None:
+                cq.remove_workload(cq.workloads[info.key])
+            self.assumed_workloads.discard(info.key)
+
+    def assume_workload(self, info: Info) -> bool:
+        """Optimistic admission before the durable write lands
+        (reference cache.go:610)."""
+        with self._lock:
+            if info.obj.admission is None or info.key in self.assumed_workloads:
+                return False
+            if self._find_owner(info) is not None:
+                return False  # already accounted — never double-count
+            cq = self._mgr.cluster_queues.get(info.obj.admission.cluster_queue)
+            if cq is None:
+                return False
+            info.cluster_queue = cq.name
+            cq.add_workload(info)
+            self.assumed_workloads.add(info.key)
+            return True
+
+    def forget_workload(self, info: Info) -> bool:
+        """reference cache.go:636."""
+        with self._lock:
+            if info.key not in self.assumed_workloads:
+                return False
+            cq = self._find_owner(info)
+            if cq is not None:
+                cq.remove_workload(cq.workloads[info.key])
+            self.assumed_workloads.discard(info.key)
+            return True
+
+    def _find_owner(self, info: Info) -> Optional[CQState]:
+        if info.cluster_queue:
+            cq = self._mgr.cluster_queues.get(info.cluster_queue)
+            if cq is not None and info.key in cq.workloads:
+                return cq
+        for cq in self._mgr.cluster_queues.values():
+            if info.key in cq.workloads:
+                return cq
+        return None
+
+    # ------------------------------------------------------------------
+    # Snapshot — reference snapshot.go:104
+    # ------------------------------------------------------------------
+
+    def snapshot(self) -> Snapshot:
+        with self._lock:
+            cq_map: dict[str, CQState] = {}
+            roots = []
+            for node in self._mgr.roots():
+                roots.append(node.payload.clone_subtree(None, cq_map))
+            for name, cq in self._mgr.cluster_queues.items():
+                if name not in cq_map:  # cohortless CQ
+                    cq_map[name] = cq.clone(parent=None)
+            inactive = {name for name, cq in self._mgr.cluster_queues.items()
+                        if not cq.active}
+            return Snapshot(
+                cluster_queues=cq_map,
+                roots=roots,
+                inactive_cluster_queues=inactive,
+                resource_flavors=dict(self.resource_flavors),
+                tas_flavors=self.tas.snapshot(),
+            )
+
+    # ------------------------------------------------------------------
+    # Status / reporting
+    # ------------------------------------------------------------------
+
+    def usage(self, cq_name: str) -> FlavorResourceQuantities:
+        cq = self._mgr.cluster_queues.get(cq_name)
+        return cq.resource_node.usage.clone() if cq else FlavorResourceQuantities()
+
+    def cluster_queue_names(self) -> list[str]:
+        return list(self._mgr.cluster_queues)
+
+    def cohort_state(self, name: str) -> Optional[CohortState]:
+        node = self._mgr.cohort(name)
+        return node.payload if node else None
+
+    # ------------------------------------------------------------------
+    # Internal wiring
+    # ------------------------------------------------------------------
+
+    def _rebuild(self) -> None:
+        """Mirror hierarchy edges into the state payloads and recompute the
+        subtree quotas from every root (reference resource_node.go:157)."""
+        for node in self._mgr.cohorts.values():
+            payload = node.payload
+            payload.parent = node.parent.payload if node.parent else None
+            payload.child_cohorts = [c.payload for c in node.child_cohorts.values()]
+            payload.child_cqs = list(node.child_cqs.values())
+        for name, cq in self._mgr.cluster_queues.items():
+            parent_node = self._mgr.cq_parent(name)
+            cq.parent = parent_node.payload if parent_node else None
+        # Cohorts in a parent-edge cycle are unreachable from any root (a
+        # cycle member is never parentless); break their mirrored parent
+        # pointers so quota queries stay total, and deactivate their CQs.
+        reachable: set[str] = set()
+        for node in self._mgr.roots():
+            for sub in node.walk_subtree():
+                reachable.add(sub.name)
+            update_cohort_resource_node(node.payload)
+        self._cyclic_cohorts = set(self._mgr.cohorts) - reachable
+        for name in self._cyclic_cohorts:
+            self._mgr.cohorts[name].payload.parent = None
+        for name, cq in self._mgr.cluster_queues.items():
+            if self._mgr.cq_parent(name) is None:
+                update_cluster_queue_resource_node(cq)
+        self._update_all_statuses()
+
+    def _update_all_statuses(self) -> None:
+        for name, cq in self._mgr.cluster_queues.items():
+            reasons = []
+            for rg in cq.spec.resource_groups:
+                for fq in rg.flavors:
+                    if fq.name not in self.resource_flavors:
+                        reasons.append(f"FlavorNotFound:{fq.name}")
+            for ac in cq.spec.admission_checks:
+                check = self.admission_checks.get(ac)
+                if check is None or not check.active:
+                    reasons.append(f"CheckNotFoundOrInactive:{ac}")
+            if cq.spec.stop_policy != StopPolicy.NONE:
+                reasons.append("Stopped")
+            parent_node = self._mgr.cq_parent(name)
+            if parent_node is not None and getattr(self, "_cyclic_cohorts", None):
+                if parent_node.name in self._cyclic_cohorts:
+                    reasons.append("CohortCycle")
+            cq.active = not reasons
+            cq.inactive_reasons = reasons

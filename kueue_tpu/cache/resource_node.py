@@ -1,0 +1,111 @@
+"""Hierarchical quota accounting over the cohort forest.
+
+Capability parity with reference pkg/cache/resource_node.go: every
+ClusterQueue and Cohort owns a ResourceNode (quotas, subtree quota, usage);
+``available`` walks to the root combining local headroom with parent
+capacity under borrowing limits; usage bubbles up past guaranteed
+(lending-limited) quota.  Values are canonical integers.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Optional, Protocol
+
+from ..api.types import ResourceQuota
+from ..resources import FlavorResource, FlavorResourceQuantities
+
+
+@dataclass
+class ResourceNode:
+    """Quotas + usage for one CQ or Cohort (reference resource_node.go:28)."""
+    quotas: dict[FlavorResource, ResourceQuota] = field(default_factory=dict)
+    subtree_quota: FlavorResourceQuantities = field(default_factory=FlavorResourceQuantities)
+    usage: FlavorResourceQuantities = field(default_factory=FlavorResourceQuantities)
+
+    def clone(self) -> "ResourceNode":
+        # quotas/subtree_quota are replaced wholesale on update → share;
+        # usage mutates → copy (reference resource_node.go:53).
+        return ResourceNode(quotas=self.quotas,
+                            subtree_quota=self.subtree_quota,
+                            usage=self.usage.clone())
+
+    def guaranteed_quota(self, fr: FlavorResource) -> int:
+        """Capacity never lent to the cohort (reference resource_node.go:63)."""
+        q = self.quotas.get(fr)
+        if q is not None and q.lending_limit is not None:
+            return max(0, self.subtree_quota.get(fr, 0) - q.lending_limit)
+        return 0
+
+
+class HierarchicalNode(Protocol):
+    """Navigation protocol over CQs and Cohorts (resource_node.go:73)."""
+    resource_node: ResourceNode
+
+    def parent_node(self) -> Optional["HierarchicalNode"]: ...
+
+
+def available(node: HierarchicalNode, fr: FlavorResource) -> int:
+    """Remaining capacity incl. borrowing (reference resource_node.go:89).
+
+    May be negative on over-admission (quota shrank under usage).
+    """
+    r = node.resource_node
+    parent = node.parent_node()
+    if parent is None:
+        return r.subtree_quota.get(fr, 0) - r.usage.get(fr, 0)
+    guaranteed = r.guaranteed_quota(fr)
+    local_available = max(0, guaranteed - r.usage.get(fr, 0))
+    parent_available = available(parent, fr)
+    q = r.quotas.get(fr)
+    if q is not None and q.borrowing_limit is not None:
+        stored_in_parent = r.subtree_quota.get(fr, 0) - guaranteed
+        used_in_parent = max(0, r.usage.get(fr, 0) - guaranteed)
+        with_max_from_parent = stored_in_parent - used_in_parent + q.borrowing_limit
+        parent_available = min(with_max_from_parent, parent_available)
+    return local_available + parent_available
+
+
+def potential_available(node: HierarchicalNode, fr: FlavorResource) -> int:
+    """Max capacity assuming zero usage (reference resource_node.go:108)."""
+    r = node.resource_node
+    parent = node.parent_node()
+    if parent is None:
+        return r.subtree_quota.get(fr, 0)
+    avail = r.guaranteed_quota(fr) + potential_available(parent, fr)
+    q = r.quotas.get(fr)
+    if q is not None and q.borrowing_limit is not None:
+        avail = min(r.subtree_quota.get(fr, 0) + q.borrowing_limit, avail)
+    return avail
+
+
+def add_usage(node: HierarchicalNode, fr: FlavorResource, val: int) -> None:
+    """Add usage, bubbling the above-guaranteed part to the parent
+    (reference resource_node.go:123)."""
+    r = node.resource_node
+    local_available = max(0, r.guaranteed_quota(fr) - r.usage.get(fr, 0))
+    r.usage[fr] = r.usage.get(fr, 0) + val
+    parent = node.parent_node()
+    if parent is not None and val > local_available:
+        add_usage(parent, fr, val - local_available)
+
+
+def remove_usage(node: HierarchicalNode, fr: FlavorResource, val: int) -> None:
+    """Remove usage, reclaiming what was stored in the parent
+    (reference resource_node.go:135)."""
+    r = node.resource_node
+    stored_in_parent = r.usage.get(fr, 0) - r.guaranteed_quota(fr)
+    r.usage[fr] = r.usage.get(fr, 0) - val
+    parent = node.parent_node()
+    if stored_in_parent <= 0 or parent is None:
+        return
+    remove_usage(parent, fr, min(val, stored_in_parent))
+
+
+def apply_usage(node: HierarchicalNode, usage: FlavorResourceQuantities,
+                sign: int) -> None:
+    for fr, qty in usage.items():
+        if sign > 0:
+            add_usage(node, fr, qty)
+        else:
+            remove_usage(node, fr, qty)

@@ -1,0 +1,57 @@
+"""Per-cycle point-in-time snapshot of admitted state.
+
+Capability parity with reference pkg/cache/snapshot.go: a deep copy of the
+cohort forest (usage cloned, quotas shared) that the scheduler mutates
+freely during nomination/preemption simulation, plus the packers' input.
+The snapshot boundary is what makes the batched TPU solver legal: a cycle
+is a pure function of (snapshot, heads).
+"""
+
+from __future__ import annotations
+
+from typing import Callable, Optional
+
+from ..resources import FlavorResourceQuantities
+from ..workload import Info
+from .state import CohortState, CQState
+
+
+class Snapshot:
+    """reference pkg/cache/snapshot.go:104."""
+
+    def __init__(self, cluster_queues: dict[str, CQState],
+                 roots: list[CohortState],
+                 inactive_cluster_queues: set[str],
+                 resource_flavors: dict,
+                 tas_flavors: dict | None = None):
+        self.cluster_queues = cluster_queues
+        self.roots = roots
+        self.inactive_cluster_queues = inactive_cluster_queues
+        self.resource_flavors = resource_flavors
+        self.tas_flavors = tas_flavors or {}
+
+    def cq(self, name: str) -> Optional[CQState]:
+        return self.cluster_queues.get(name)
+
+    def add_workload(self, info: Info) -> None:
+        """reference snapshot.go:44."""
+        cq = self.cluster_queues.get(info.cluster_queue)
+        if cq is not None:
+            cq.add_workload(info)
+
+    def remove_workload(self, info: Info) -> None:
+        """reference snapshot.go:50."""
+        cq = self.cluster_queues.get(info.cluster_queue)
+        if cq is not None:
+            cq.remove_workload(info)
+
+    def simulate_workload_removal(self, infos: list[Info]) -> Callable[[], None]:
+        """Remove a set of workloads, returning a revert closure
+        (reference clusterqueue_snapshot.go:73)."""
+        for info in infos:
+            self.remove_workload(info)
+
+        def revert() -> None:
+            for info in infos:
+                self.add_workload(info)
+        return revert

@@ -1,0 +1,229 @@
+"""Topology flavor snapshot: the domain tree + assignment algorithm.
+
+Capability parity with reference pkg/cache/tas_flavor_snapshot.go:91: a tree
+of topology domains (e.g. block → rack → hostname) built from node labels,
+with per-leaf free capacity.  ``find_topology_assignment`` mirrors the
+two-phase algorithm (tas_flavor_snapshot.go:406-613): phase 1 fills pod
+counts bottom-up; phase 2 picks the lowest level whose best domain fits all
+pods (falling back upward for `preferred`), then walks down level by level
+minimizing the number of domains (BestFit).
+
+The batched/TPU formulation of the same algorithm lives in
+kueue_tpu.ops.tas_kernel (segment reductions over a level-indexed CSR tree).
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Optional
+
+from ..api.types import (
+    PodSetTopologyRequest,
+    TopologyAssignment,
+    TopologyDomainAssignment,
+)
+from .tas_cache import NodeInfo
+
+
+@dataclass
+class Domain:
+    """One topology domain (reference tas_flavor_snapshot.go `domain`)."""
+    id: tuple                      # label values from root level to this level
+    level: int
+    parent: Optional["Domain"] = None
+    children: list["Domain"] = field(default_factory=list)
+    # leaf-only: free capacity (canonical ints)
+    free: dict[str, int] = field(default_factory=dict)
+    # per-query state
+    state: int = 0                 # how many pods fit in this subtree
+
+
+class TASFlavorSnapshot:
+    def __init__(self, flavor: str, levels: list[str]):
+        self.flavor = flavor
+        self.levels = levels
+        self.leaves: dict[tuple, Domain] = {}
+        self.roots: list[Domain] = []
+        self.domains_per_level: list[list[Domain]] = [[] for _ in levels]
+
+    @staticmethod
+    def build(flavor: str, levels: list[str], nodes: list[NodeInfo],
+              usage: dict[tuple, dict[str, int]]) -> "TASFlavorSnapshot":
+        snap = TASFlavorSnapshot(flavor, levels)
+        by_id: dict[tuple, Domain] = {}
+        for node in nodes:
+            values = tuple(node.labels.get(lvl, "") for lvl in levels)
+            if any(v == "" for v in values):
+                continue  # node not fully labelled for this topology
+            leaf = by_id.get(values)
+            if leaf is None:
+                leaf = Domain(id=values, level=len(levels) - 1)
+                by_id[values] = leaf
+                snap.leaves[values] = leaf
+            for rname, cap in node.capacity.items():
+                leaf.free[rname] = leaf.free.get(rname, 0) + cap
+        for dom_id, used in usage.items():
+            leaf = snap.leaves.get(tuple(dom_id))
+            if leaf is not None:
+                for rname, qty in used.items():
+                    leaf.free[rname] = leaf.free.get(rname, 0) - qty
+        # link up the tree
+        for leaf in list(snap.leaves.values()):
+            child = leaf
+            for lvl in range(len(levels) - 2, -1, -1):
+                pid = child.id[: lvl + 1]
+                parent = by_id.get(pid)
+                if parent is None:
+                    parent = Domain(id=pid, level=lvl)
+                    by_id[pid] = parent
+                if child.parent is None:
+                    child.parent = parent
+                    parent.children.append(child)
+                child = parent
+        for dom in by_id.values():
+            snap.domains_per_level[dom.level].append(dom)
+            if dom.level == 0:
+                snap.roots.append(dom)
+        return snap
+
+    # ------------------------------------------------------------------
+
+    def _fill_in_counts(self, per_pod: dict[str, int],
+                        assumed: dict[tuple, dict[str, int]] | None = None) -> None:
+        """Phase 1 (reference tas_flavor_snapshot.go fillInCounts): compute
+        how many pods fit in each domain, bottom-up."""
+        for leaf in self.leaves.values():
+            fits = None
+            for rname, need in per_pod.items():
+                if need <= 0:
+                    continue
+                free = leaf.free.get(rname, 0)
+                if assumed:
+                    free -= assumed.get(leaf.id, {}).get(rname, 0)
+                n = max(0, free) // need
+                fits = n if fits is None else min(fits, n)
+            leaf.state = fits if fits is not None else 0
+        for lvl in range(len(self.levels) - 2, -1, -1):
+            for dom in self.domains_per_level[lvl]:
+                dom.state = sum(c.state for c in dom.children)
+
+    def _level_index(self, label: Optional[str]) -> Optional[int]:
+        if label is None:
+            return None
+        try:
+            return self.levels.index(label)
+        except ValueError:
+            return None
+
+    def find_topology_assignment(
+            self, count: int, per_pod: dict[str, int],
+            request: PodSetTopologyRequest,
+            assumed: dict[tuple, dict[str, int]] | None = None,
+    ) -> tuple[Optional[TopologyAssignment], str]:
+        """Phase 1 + 2 (reference tas_flavor_snapshot.go:406-613).
+
+        Returns (assignment at the leaf level, reason-on-failure).
+        """
+        if not self.levels:
+            return None, "no topology levels"
+        self._fill_in_counts(per_pod, assumed)
+
+        required_idx = self._level_index(request.required)
+        preferred_idx = self._level_index(request.preferred)
+        if request.required and required_idx is None:
+            return None, f"level {request.required} not in topology"
+        if request.preferred and preferred_idx is None:
+            return None, f"level {request.preferred} not in topology"
+
+        if request.unconstrained:
+            # any set of leaves; minimize domain count from the top
+            total = sum(r.state for r in self.roots)
+            if total < count:
+                return None, self._fit_message(count, total)
+            chosen = self._select_from(sorted(self.roots, key=self._domain_order), count)
+        else:
+            if required_idx is not None:
+                fit_idx, domain = self._find_fit_at(required_idx, count)
+                if domain is None:
+                    return None, self._fit_message_level(count, required_idx)
+            else:
+                start = preferred_idx if preferred_idx is not None else len(self.levels) - 1
+                fit_idx, domain = None, None
+                for lvl in range(start, -1, -1):
+                    fit_idx, domain = self._find_fit_at(lvl, count)
+                    if domain is not None:
+                        break
+                if domain is None:
+                    # final fallback: split across root domains
+                    total = sum(r.state for r in self.roots)
+                    if total < count:
+                        return None, self._fit_message(count, total)
+                    chosen = self._select_from(
+                        sorted(self.roots, key=self._domain_order), count)
+                    return self._assignment_from(chosen), ""
+            chosen = {domain: count}
+        return self._assignment_from(chosen), ""
+
+    # -- helpers --
+
+    @staticmethod
+    def _domain_order(dom: Domain):
+        # BestFit: prefer tighter domains first to reduce fragmentation,
+        # largest-capacity ordering for splitting (fewest domains).
+        return (-dom.state, dom.id)
+
+    def _find_fit_at(self, level: int, count: int) -> tuple[int, Optional[Domain]]:
+        """Best single domain at `level` that fits all pods: the one with the
+        least spare capacity (BestFit), ties by id."""
+        best = None
+        for dom in self.domains_per_level[level]:
+            if dom.state >= count:
+                if best is None or (dom.state, dom.id) < (best.state, best.id):
+                    best = dom
+        return level, best
+
+    def _select_from(self, ordered: list[Domain], count: int) -> dict[Domain, int]:
+        """Greedy multi-domain split: take largest domains first (fewest
+        domains; reference updateCountsToMinimum)."""
+        chosen: dict[Domain, int] = {}
+        remaining = count
+        for dom in ordered:
+            if remaining <= 0:
+                break
+            take = min(dom.state, remaining)
+            if take > 0:
+                chosen[dom] = take
+                remaining -= take
+        return chosen
+
+    def _assignment_from(self, chosen: dict[Domain, int]) -> TopologyAssignment:
+        """Walk chosen domains down to leaves, minimizing leaf-domain count."""
+        leaf_counts: dict[tuple, int] = {}
+        for dom, cnt in chosen.items():
+            self._descend(dom, cnt, leaf_counts)
+        domains = [TopologyDomainAssignment(values=list(dom_id), count=cnt)
+                   for dom_id, cnt in sorted(leaf_counts.items())]
+        return TopologyAssignment(levels=list(self.levels), domains=domains)
+
+    def _descend(self, dom: Domain, cnt: int, out: dict[tuple, int]) -> None:
+        if not dom.children:  # leaf
+            out[dom.id] = out.get(dom.id, 0) + cnt
+            return
+        remaining = cnt
+        # BestFit at each level: pick the fullest-fitting children first
+        for child in sorted(dom.children, key=self._domain_order):
+            if remaining <= 0:
+                break
+            take = min(child.state, remaining)
+            if take > 0:
+                self._descend(child, take, out)
+                remaining -= take
+
+    def _fit_message(self, count: int, total: int) -> str:
+        return (f"topology {self.flavor!r} allows to fit only {total} "
+                f"out of {count} pod(s)")
+
+    def _fit_message_level(self, count: int, level: int) -> str:
+        best = max((d.state for d in self.domains_per_level[level]), default=0)
+        return (f"topology {self.flavor!r} allows to fit only {best} "
+                f"out of {count} pod(s) in a single {self.levels[level]!r}")

@@ -1,0 +1,144 @@
+"""Generic cohort-forest manager shared by cache and queue.
+
+Capability parity with reference pkg/hierarchy (manager.go:27, cohort.go:26,
+cycle.go:31): ClusterQueue-nodes attach to Cohort-nodes; Cohorts attach to
+parent Cohorts, forming a forest.  Cohorts can exist implicitly (referenced
+before being created explicitly) and vanish when no longer referenced and
+not explicit.  Cycle detection guards edge updates.
+"""
+
+from __future__ import annotations
+
+from typing import Callable, Generic, Iterator, Optional, TypeVar
+
+CQ = TypeVar("CQ")
+C = TypeVar("C")
+
+
+class CohortNode(Generic[CQ, C]):
+    """Wiring record for one cohort: payload + tree links."""
+
+    def __init__(self, name: str, payload: C):
+        self.name = name
+        self.payload = payload
+        self.parent: Optional["CohortNode[CQ, C]"] = None
+        self.child_cohorts: dict[str, "CohortNode[CQ, C]"] = {}
+        self.child_cqs: dict[str, CQ] = {}
+        self.explicit = False  # created by an explicit Cohort object
+
+    def has_parent(self) -> bool:
+        return self.parent is not None
+
+    def childless(self) -> bool:
+        return not self.child_cohorts and not self.child_cqs
+
+    def root(self) -> "CohortNode[CQ, C]":
+        node = self
+        while node.parent is not None:
+            node = node.parent
+        return node
+
+    def walk_subtree(self) -> Iterator["CohortNode[CQ, C]"]:
+        yield self
+        for child in self.child_cohorts.values():
+            yield from child.walk_subtree()
+
+    def subtree_cqs(self) -> Iterator[CQ]:
+        for node in self.walk_subtree():
+            yield from node.child_cqs.values()
+
+
+class Manager(Generic[CQ, C]):
+    """Maintains the CQ/Cohort forest (reference pkg/hierarchy/manager.go:27)."""
+
+    def __init__(self, cohort_factory: Callable[[str], C]):
+        self._cohort_factory = cohort_factory
+        self.cluster_queues: dict[str, CQ] = {}
+        self.cohorts: dict[str, CohortNode[CQ, C]] = {}
+        self._cq_parent: dict[str, CohortNode[CQ, C]] = {}
+
+    # -- ClusterQueues --
+
+    def add_cluster_queue(self, name: str, cq: CQ) -> None:
+        self.cluster_queues[name] = cq
+
+    def update_cluster_queue_edge(self, name: str, cohort_name: Optional[str]) -> None:
+        self._detach_cq(name)
+        if cohort_name:
+            node = self._get_or_create(cohort_name)
+            node.child_cqs[name] = self.cluster_queues[name]
+            self._cq_parent[name] = node
+
+    def delete_cluster_queue(self, name: str) -> None:
+        self._detach_cq(name)
+        self.cluster_queues.pop(name, None)
+
+    def cq_parent(self, name: str) -> Optional[CohortNode[CQ, C]]:
+        return self._cq_parent.get(name)
+
+    # -- Cohorts --
+
+    def add_cohort(self, name: str) -> CohortNode[CQ, C]:
+        node = self._get_or_create(name)
+        node.explicit = True
+        return node
+
+    def update_cohort_edge(self, name: str, parent_name: Optional[str]) -> None:
+        node = self._get_or_create(name)
+        old_parent = node.parent
+        if old_parent is not None:
+            old_parent.child_cohorts.pop(name, None)
+            node.parent = None
+            self._maybe_gc(old_parent)
+        if parent_name:
+            parent = self._get_or_create(parent_name)
+            parent.child_cohorts[name] = node
+            node.parent = parent
+
+    def delete_cohort(self, name: str) -> None:
+        node = self.cohorts.get(name)
+        if node is None:
+            return
+        node.explicit = False
+        if node.parent is not None:
+            node.parent.child_cohorts.pop(name, None)
+            parent, node.parent = node.parent, None
+            self._maybe_gc(parent)
+        self._maybe_gc(node)
+
+    def cohort(self, name: str) -> Optional[CohortNode[CQ, C]]:
+        return self.cohorts.get(name)
+
+    def roots(self) -> list[CohortNode[CQ, C]]:
+        return [n for n in self.cohorts.values() if n.parent is None]
+
+    # -- internals --
+
+    def _detach_cq(self, name: str) -> None:
+        node = self._cq_parent.pop(name, None)
+        if node is not None:
+            node.child_cqs.pop(name, None)
+            self._maybe_gc(node)
+
+    def _get_or_create(self, name: str) -> CohortNode[CQ, C]:
+        node = self.cohorts.get(name)
+        if node is None:
+            node = CohortNode(name, self._cohort_factory(name))
+            self.cohorts[name] = node
+        return node
+
+    def _maybe_gc(self, node: CohortNode[CQ, C]) -> None:
+        if not node.explicit and node.childless() and node.parent is None:
+            self.cohorts.pop(node.name, None)
+
+
+def has_cycle(node: CohortNode) -> bool:
+    """Cycle check walking parent pointers (reference pkg/hierarchy/cycle.go:31)."""
+    seen = set()
+    cur: Optional[CohortNode] = node
+    while cur is not None:
+        if id(cur) in seen:
+            return True
+        seen.add(id(cur))
+        cur = cur.parent
+    return False

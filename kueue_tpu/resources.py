@@ -1,0 +1,79 @@
+"""The quota currency: (flavor, resource) keyed integer quantities.
+
+Capability parity with reference pkg/resources/resource.go + requests.go:
+``FlavorResource`` keys and ``FlavorResourceQuantities`` /``Requests`` maps
+with add/sub/clone algebra.  All values are canonical integers (milli-units
+for cpu, whole units otherwise — see kueue_tpu.api.quantity).
+"""
+
+from __future__ import annotations
+
+from typing import Iterable, NamedTuple
+
+
+class FlavorResource(NamedTuple):
+    flavor: str
+    resource: str
+
+
+class Requests(dict):
+    """map[resource]→int with algebra (reference pkg/resources/requests.go)."""
+
+    def add(self, other: "Requests | dict[str, int]") -> "Requests":
+        for k, v in other.items():
+            self[k] = self.get(k, 0) + v
+        return self
+
+    def sub(self, other: "Requests | dict[str, int]") -> "Requests":
+        for k, v in other.items():
+            self[k] = self.get(k, 0) - v
+        return self
+
+    def mul(self, factor: int) -> "Requests":
+        for k in self:
+            self[k] *= factor
+        return self
+
+    def clone(self) -> "Requests":
+        return Requests(self)
+
+    def count_in(self, capacity: "Requests | dict[str, int]") -> int:
+        """How many copies of self fit in capacity (reference requests.go CountIn)."""
+        fits = None
+        for name, per_unit in self.items():
+            if per_unit <= 0:
+                continue
+            avail = max(0, capacity.get(name, 0))
+            n = avail // per_unit
+            fits = n if fits is None else min(fits, n)
+        return 0 if fits is None else fits
+
+
+class FlavorResourceQuantities(dict):
+    """map[FlavorResource]→int with algebra."""
+
+    def add(self, other: "FlavorResourceQuantities | dict") -> "FlavorResourceQuantities":
+        for k, v in other.items():
+            self[k] = self.get(k, 0) + v
+        return self
+
+    def sub(self, other: "FlavorResourceQuantities | dict") -> "FlavorResourceQuantities":
+        for k, v in other.items():
+            self[k] = self.get(k, 0) - v
+        return self
+
+    def clone(self) -> "FlavorResourceQuantities":
+        return FlavorResourceQuantities(self)
+
+    def flavors(self) -> set[str]:
+        return {fr.flavor for fr in self}
+
+    def resources(self) -> set[str]:
+        return {fr.resource for fr in self}
+
+
+def sum_requests(items: Iterable[Requests]) -> Requests:
+    total = Requests()
+    for r in items:
+        total.add(r)
+    return total

@@ -1,0 +1,310 @@
+"""Workload runtime info: computed requests, status transitions, ordering.
+
+Capability parity with reference pkg/workload/workload.go: ``Info`` wraps a
+Workload with computed per-PodSet total requests (reclaimable pods,
+resource transformations, excluded prefixes — workload.go:163-382), flavor
+usage (usage.go), queue-order timestamps (workload.go:723), requeue backoff
+(workload.go:514-539), and the status setters the scheduler/controllers use.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from dataclasses import dataclass, field
+from typing import Optional
+
+from .api.types import (
+    Admission,
+    AdmissionCheckState,
+    AdmissionCheckStatus,
+    Condition,
+    ConditionStatus,
+    PodSet,
+    PodSetAssignment,
+    RequeueState,
+    Workload,
+    EVICTED_BY_ADMISSION_CHECK,
+    EVICTED_BY_PODS_READY_TIMEOUT,
+    IN_COHORT_RECLAIM_WHILE_BORROWING_REASON,
+    WL_ADMITTED,
+    WL_EVICTED,
+    WL_FINISHED,
+    WL_PREEMPTED,
+    WL_QUOTA_RESERVED,
+    WL_REQUEUED,
+)
+from .resources import FlavorResource, FlavorResourceQuantities, Requests
+
+
+# ---------------------------------------------------------------------------
+# Resource transformations / exclusions (apis/config/v1beta1 Resources)
+# ---------------------------------------------------------------------------
+
+@dataclass
+class ResourceTransformation:
+    input: str
+    strategy: str = "Retain"  # Retain | Replace
+    outputs: dict[str, int] = field(default_factory=dict)  # per input unit
+
+
+@dataclass
+class InfoOptions:
+    excluded_prefixes: list[str] = field(default_factory=list)
+    transformations: dict[str, ResourceTransformation] = field(default_factory=dict)
+
+
+def _apply_transformations(requests: Requests, opts: InfoOptions) -> Requests:
+    """Reference workload.go:320 (applyResourceTransformations) +
+    dropExcludedResources (workload.go:267)."""
+    out = Requests()
+    for name, value in requests.items():
+        tr = opts.transformations.get(name)
+        if tr is not None:
+            for oname, per_unit in tr.outputs.items():
+                out[oname] = out.get(oname, 0) + value * per_unit
+            if tr.strategy == "Retain":
+                out[name] = out.get(name, 0) + value
+        else:
+            out[name] = out.get(name, 0) + value
+    for name in list(out):
+        if any(name == p or name.startswith(p) for p in opts.excluded_prefixes):
+            del out[name]
+    return out
+
+
+# ---------------------------------------------------------------------------
+# Info
+# ---------------------------------------------------------------------------
+
+@dataclass
+class PodSetResources:
+    name: str
+    requests: Requests           # total for the podset (per-pod × count)
+    count: int                   # pods actually counted (after reclaim)
+    flavors: dict[str, str] = field(default_factory=dict)  # resource → flavor
+    topology_request: object = None
+
+    def scaled_to(self, new_count: int) -> "PodSetResources":
+        """Scale requests to a different pod count (partial admission;
+        reference workload.go ScaledTo)."""
+        if self.count == new_count or self.count == 0:
+            return dataclasses.replace(self, count=new_count,
+                                       requests=self.requests.clone(),
+                                       flavors=dict(self.flavors))
+        per_pod = {k: v // self.count for k, v in self.requests.items()}
+        return PodSetResources(
+            name=self.name,
+            requests=Requests({k: v * new_count for k, v in per_pod.items()}),
+            count=new_count,
+            flavors=dict(self.flavors),
+            topology_request=self.topology_request,
+        )
+
+
+class Info:
+    """A Workload plus computed TotalRequests (reference workload.go:153)."""
+
+    def __init__(self, wl: Workload, opts: InfoOptions | None = None):
+        self.obj = wl
+        self.opts = opts or InfoOptions()
+        self.cluster_queue: str = wl.admission.cluster_queue if wl.admission else ""
+        self.total_requests: list[PodSetResources] = self._compute_total_requests()
+        # Flavor-assignment resume state (reference workload.go:82
+        # AssignmentClusterQueueState) — attached by the scheduler.
+        self.last_assignment = None
+
+    # -- requests --
+
+    def _reclaim_count(self, ps_name: str) -> int:
+        for rp in self.obj.reclaimable_pods:
+            if rp.name == ps_name:
+                return rp.count
+        return 0
+
+    def _compute_total_requests(self) -> list[PodSetResources]:
+        wl = self.obj
+        out = []
+        if wl.admission is not None:
+            assignments = {a.name: a for a in wl.admission.pod_set_assignments}
+        else:
+            assignments = {}
+        for ps in wl.pod_sets:
+            asg = assignments.get(ps.name)
+            count = asg.count if asg is not None and asg.count else ps.count
+            count = max(0, count - self._reclaim_count(ps.name))
+            per_pod = _apply_transformations(Requests(ps.requests), self.opts)
+            total = Requests({k: v * count for k, v in per_pod.items()})
+            flavors = dict(asg.flavors) if asg is not None else {}
+            out.append(PodSetResources(
+                name=ps.name, requests=total, count=count, flavors=flavors,
+                topology_request=ps.topology_request))
+        return out
+
+    def usage(self) -> FlavorResourceQuantities:
+        """Quota usage by (flavor, resource) (reference usage.go / workload.go:244)."""
+        usage = FlavorResourceQuantities()
+        for psr in self.total_requests:
+            for rname, qty in psr.requests.items():
+                flavor = psr.flavors.get(rname, "")
+                fr = FlavorResource(flavor, rname)
+                usage[fr] = usage.get(fr, 0) + qty
+        return usage
+
+    def sum_requests(self) -> Requests:
+        total = Requests()
+        for psr in self.total_requests:
+            total.add(psr.requests)
+        return total
+
+    @property
+    def key(self) -> str:
+        return self.obj.key
+
+    @property
+    def priority(self) -> int:
+        return self.obj.priority
+
+    def update_from(self, wl: Workload) -> None:
+        self.obj = wl
+        self.cluster_queue = wl.admission.cluster_queue if wl.admission else self.cluster_queue
+        self.total_requests = self._compute_total_requests()
+
+    def clone(self) -> "Info":
+        info = Info(self.obj.clone(), self.opts)
+        info.cluster_queue = self.cluster_queue
+        info.last_assignment = self.last_assignment
+        return info
+
+
+# ---------------------------------------------------------------------------
+# Status transitions (reference workload.go:588-721)
+# ---------------------------------------------------------------------------
+
+def set_quota_reservation(wl: Workload, admission: Admission, now: float) -> None:
+    """Reference workload.go:588 SetQuotaReservation."""
+    wl.admission = admission
+    wl.set_condition(WL_QUOTA_RESERVED, ConditionStatus.TRUE,
+                     reason="QuotaReserved",
+                     message=f"Quota reserved in ClusterQueue {admission.cluster_queue}",
+                     now=now)
+    # Eviction/preemption history is cleared on fresh reservation.
+    for cond_type in (WL_EVICTED, WL_PREEMPTED):
+        c = wl.conditions.get(cond_type)
+        if c is not None and c.status == ConditionStatus.TRUE:
+            wl.set_condition(cond_type, ConditionStatus.FALSE,
+                             reason="QuotaReserved", message="Previous eviction cleared",
+                             now=now)
+
+
+def unset_quota_reservation(wl: Workload, reason: str, message: str, now: float) -> None:
+    """Reference workload.go:490 UnsetQuotaReservationWithCondition."""
+    wl.set_condition(WL_QUOTA_RESERVED, ConditionStatus.FALSE, reason=reason,
+                     message=message, now=now)
+    wl.admission = None
+    sync_admitted_condition(wl, now)
+
+
+def sync_admitted_condition(wl: Workload, now: float) -> bool:
+    """Admitted = QuotaReserved AND all admission checks Ready
+    (reference workload.go SyncAdmittedCondition)."""
+    reserved = wl.condition_true(WL_QUOTA_RESERVED)
+    checks_ready = all(
+        st.state == AdmissionCheckState.READY
+        for st in wl.admission_check_states.values())
+    admitted = reserved and checks_ready
+    was = wl.is_admitted
+    if admitted and not was:
+        wl.set_condition(WL_ADMITTED, ConditionStatus.TRUE, reason="Admitted",
+                         message="The workload is admitted", now=now)
+    elif not admitted and was:
+        reason = "NoReservation" if not reserved else "UnsatisfiedChecks"
+        wl.set_condition(WL_ADMITTED, ConditionStatus.FALSE, reason=reason, now=now)
+    return admitted != was
+
+
+def set_evicted_condition(wl: Workload, reason: str, message: str, now: float) -> None:
+    """Reference workload.go:637 SetEvictedCondition."""
+    wl.set_condition(WL_EVICTED, ConditionStatus.TRUE, reason=reason,
+                     message=message, now=now)
+    key = reason
+    wl.scheduling_stats_evictions[key] = wl.scheduling_stats_evictions.get(key, 0) + 1
+
+
+def set_preempted_condition(wl: Workload, reason: str, message: str, now: float) -> None:
+    wl.set_condition(WL_PREEMPTED, ConditionStatus.TRUE, reason=reason,
+                     message=message, now=now)
+
+
+def set_requeued_condition(wl: Workload, reason: str, message: str,
+                           status: bool, now: float) -> None:
+    wl.set_condition(WL_REQUEUED,
+                     ConditionStatus.TRUE if status else ConditionStatus.FALSE,
+                     reason=reason, message=message, now=now)
+
+
+def set_finished_condition(wl: Workload, reason: str, message: str, now: float) -> None:
+    wl.set_condition(WL_FINISHED, ConditionStatus.TRUE, reason=reason,
+                     message=message, now=now)
+
+
+def update_requeue_state(wl: Workload, backoff_base_seconds: int,
+                         backoff_max_seconds: int, now: float,
+                         jitter: float = 0.0) -> None:
+    """Exponential requeue backoff: base·2^(n−1) capped at max
+    (reference workload.go:514 UpdateRequeueState)."""
+    if wl.requeue_state is None:
+        wl.requeue_state = RequeueState()
+    count = wl.requeue_state.count + 1
+    wait_s = min(backoff_base_seconds * (2 ** (count - 1)),
+                 backoff_max_seconds)
+    wait_s += wait_s * jitter
+    wl.requeue_state.requeue_at = now + wait_s
+    wl.requeue_state.count = count
+
+
+# ---------------------------------------------------------------------------
+# Queue ordering (reference workload.go:723-769)
+# ---------------------------------------------------------------------------
+
+@dataclass
+class Ordering:
+    """Timestamp policy for queue ordering (reference workload.go:723)."""
+    pods_ready_requeuing_timestamp: str = "Eviction"  # Eviction | Creation
+    priority_sorting_within_cohort: bool = True       # feature gate
+
+    def queue_order_timestamp(self, wl: Workload) -> float:
+        evicted = wl.conditions.get(WL_EVICTED)
+        if (self.pods_ready_requeuing_timestamp == "Eviction"
+                and evicted is not None and evicted.status == ConditionStatus.TRUE
+                and evicted.reason == EVICTED_BY_PODS_READY_TIMEOUT):
+            return evicted.last_transition_time
+        if (evicted is not None and evicted.status == ConditionStatus.TRUE
+                and evicted.reason == EVICTED_BY_ADMISSION_CHECK):
+            return evicted.last_transition_time
+        if not self.priority_sorting_within_cohort:
+            preempted = wl.conditions.get(WL_PREEMPTED)
+            if (preempted is not None and preempted.status == ConditionStatus.TRUE
+                    and preempted.reason == IN_COHORT_RECLAIM_WHILE_BORROWING_REASON):
+                return preempted.last_transition_time + 0.001
+        return wl.creation_time
+
+
+def queued_wait_time(wl: Workload, now: float) -> float:
+    """Reference workload.go QueuedWaitTime."""
+    queued = wl.creation_time
+    c = wl.conditions.get(WL_REQUEUED)
+    if c is not None:
+        queued = c.last_transition_time
+    return now - queued
+
+
+def admission_status_patch(wl: Workload) -> dict:
+    """SSA-shaped decision record the driver emits (reference
+    ApplyAdmissionStatus, workload.go:711). Pure data: applied by the store."""
+    return {
+        "key": wl.key,
+        "admission": wl.admission,
+        "conditions": dict(wl.conditions),
+        "requeue_state": wl.requeue_state,
+        "admission_check_states": dict(wl.admission_check_states),
+    }

@@ -1,0 +1,8 @@
+import os
+
+# Multi-chip sharding is validated on a virtual 8-device CPU mesh; the real
+# TPU path is exercised by bench.py / the driver.
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in flags:
+    os.environ["XLA_FLAGS"] = (flags + " --xla_force_host_platform_device_count=8").strip()

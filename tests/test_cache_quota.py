@@ -1,0 +1,244 @@
+"""Hierarchical quota math tests, mirroring reference pkg/cache semantics
+(resource_node.go, fair_sharing.go, snapshot.go)."""
+
+from kueue_tpu.api.types import (
+    Admission,
+    ClusterQueue,
+    Cohort,
+    ConditionStatus,
+    FairSharing,
+    FlavorQuotas,
+    PodSet,
+    PodSetAssignment,
+    ResourceFlavor,
+    ResourceGroup,
+    ResourceQuota,
+    Workload,
+    WL_QUOTA_RESERVED,
+)
+from kueue_tpu.cache import Cache
+from kueue_tpu.resources import FlavorResource, FlavorResourceQuantities
+from kueue_tpu.workload import Info
+
+
+def make_cq(name, cohort=None, nominal=10_000, borrowing_limit=None,
+            lending_limit=None, weight=None):
+    return ClusterQueue(
+        name=name,
+        cohort=cohort,
+        resource_groups=[ResourceGroup(
+            covered_resources=["cpu"],
+            flavors=[FlavorQuotas(name="default", resources={
+                "cpu": ResourceQuota(nominal=nominal,
+                                     borrowing_limit=borrowing_limit,
+                                     lending_limit=lending_limit)})])],
+        fair_sharing=FairSharing(weight=weight) if weight is not None else None,
+    )
+
+
+def admitted_workload(name, cq, cpu_milli, count=1):
+    wl = Workload(name=name, pod_sets=[PodSet(name="main", count=count,
+                                              requests={"cpu": cpu_milli})])
+    wl.admission = Admission(cluster_queue=cq, pod_set_assignments=[
+        PodSetAssignment(name="main", flavors={"cpu": "default"},
+                         resource_usage={"cpu": cpu_milli * count}, count=count)])
+    wl.set_condition(WL_QUOTA_RESERVED, ConditionStatus.TRUE)
+    return Info(wl)
+
+
+FR = FlavorResource("default", "cpu")
+
+
+def build_cache(*cqs, cohorts=()):
+    cache = Cache()
+    cache.add_or_update_resource_flavor(ResourceFlavor(name="default"))
+    for c in cohorts:
+        cache.add_or_update_cohort(c)
+    for cq in cqs:
+        cache.add_or_update_cluster_queue(cq)
+    return cache
+
+
+def test_standalone_cq_available():
+    cache = build_cache(make_cq("cq1"))
+    cq = cache.cluster_queue("cq1")
+    assert cq.available(FR) == 10_000
+    cache.add_or_update_workload(admitted_workload("w1", "cq1", 3_000))
+    assert cq.available(FR) == 7_000
+    assert cq.fits(FlavorResourceQuantities({FR: 7_000}))
+    assert not cq.fits(FlavorResourceQuantities({FR: 7_001}))
+
+
+def test_cohort_borrowing_unlimited():
+    cache = build_cache(make_cq("cq1", cohort="team"), make_cq("cq2", cohort="team"))
+    cq1 = cache.cluster_queue("cq1")
+    # idle cohort: cq1 can use the full 20 via borrowing
+    assert cq1.available(FR) == 20_000
+    cache.add_or_update_workload(admitted_workload("w1", "cq1", 15_000))
+    assert cq1.available(FR) == 5_000
+    cq2 = cache.cluster_queue("cq2")
+    assert cq2.available(FR) == 5_000
+    assert cq1.is_borrowing()
+    assert not cq2.is_borrowing()
+
+
+def test_borrowing_limit():
+    cache = build_cache(make_cq("cq1", cohort="team", borrowing_limit=5_000),
+                        make_cq("cq2", cohort="team"))
+    cq1 = cache.cluster_queue("cq1")
+    assert cq1.available(FR) == 15_000
+    assert cq1.potential_available(FR) == 15_000
+
+
+def test_lending_limit():
+    cache = build_cache(make_cq("cq1", cohort="team"),
+                        make_cq("cq2", cohort="team", lending_limit=3_000))
+    cq1 = cache.cluster_queue("cq1")
+    cq2 = cache.cluster_queue("cq2")
+    # cq2 guarantees 7 for itself; cohort pool = 10 (cq1) + 3 (cq2)
+    assert cq1.available(FR) == 13_000
+    # cq2 sees its guaranteed 7 locally + 13 in the cohort
+    assert cq2.available(FR) == 13_000 + 7_000
+    # cq2's own usage below guaranteed does not reduce cq1's view
+    cache.add_or_update_workload(admitted_workload("w1", "cq2", 6_000))
+    assert cq1.available(FR) == 13_000
+
+
+def test_usage_bubbles_and_unwinds():
+    cache = build_cache(make_cq("cq1", cohort="team"), make_cq("cq2", cohort="team"))
+    info = admitted_workload("w1", "cq1", 12_000)
+    cache.add_or_update_workload(info)
+    cq2 = cache.cluster_queue("cq2")
+    assert cq2.available(FR) == 8_000
+    cache.delete_workload(info)
+    assert cq2.available(FR) == 20_000
+    assert cache.cluster_queue("cq1").resource_node.usage.get(FR, 0) == 0
+
+
+def test_hierarchical_cohorts():
+    # org has its own 5 CPU quota; teams are children
+    org = Cohort(name="org", resource_groups=[ResourceGroup(
+        covered_resources=["cpu"],
+        flavors=[FlavorQuotas(name="default",
+                              resources={"cpu": ResourceQuota(nominal=5_000)})])])
+    team_a = Cohort(name="team-a", parent_name="org")
+    team_b = Cohort(name="team-b", parent_name="org")
+    cache = build_cache(make_cq("cq-a", cohort="team-a"),
+                        make_cq("cq-b", cohort="team-b"),
+                        cohorts=(org, team_a, team_b))
+    cq_a = cache.cluster_queue("cq-a")
+    # full tree: 10 (cq-a) + 10 (cq-b) + 5 (org) = 25
+    assert cq_a.available(FR) == 25_000
+    cache.add_or_update_workload(admitted_workload("w1", "cq-b", 20_000))
+    assert cq_a.available(FR) == 5_000
+
+
+def test_assume_and_forget():
+    cache = build_cache(make_cq("cq1"))
+    cq = cache.cluster_queue("cq1")
+    info = admitted_workload("w1", "cq1", 4_000)
+    assert cache.assume_workload(info)
+    assert cq.available(FR) == 6_000
+    assert not cache.assume_workload(info)  # double-assume rejected
+    assert cache.forget_workload(info)
+    assert cq.available(FR) == 10_000
+    assert not cache.forget_workload(info)
+
+
+def test_snapshot_isolation():
+    cache = build_cache(make_cq("cq1", cohort="team"), make_cq("cq2", cohort="team"))
+    info = admitted_workload("w1", "cq1", 5_000)
+    cache.add_or_update_workload(info)
+    snap = cache.snapshot()
+    scq1 = snap.cq("cq1")
+    assert scq1.available(FR) == 15_000
+    # mutating the snapshot leaves the live cache untouched
+    snap.remove_workload(snap.cq("cq1").workloads["default/w1"])
+    assert scq1.available(FR) == 20_000
+    assert cache.cluster_queue("cq1").available(FR) == 15_000
+    # simulate + revert round-trips
+    snap2 = cache.snapshot()
+    revert = snap2.simulate_workload_removal(
+        [snap2.cq("cq1").workloads["default/w1"]])
+    assert snap2.cq("cq1").available(FR) == 20_000
+    revert()
+    assert snap2.cq("cq1").available(FR) == 15_000
+
+
+def test_dominant_resource_share():
+    cache = build_cache(make_cq("cq1", cohort="team"), make_cq("cq2", cohort="team"))
+    cq1 = cache.cluster_queue("cq1")
+    assert cq1.dominant_resource_share() == (0, "")
+    cache.add_or_update_workload(admitted_workload("w1", "cq1", 15_000))
+    # borrowing 5 of 20 lendable -> 5*1000/20 = 250
+    assert cq1.dominant_resource_share() == (250, "cpu")
+    # with a hypothetical extra 5 CPU -> 500
+    drs, _ = cq1.dominant_resource_share(FlavorResourceQuantities({FR: 5_000}))
+    assert drs == 500
+
+
+def test_dominant_resource_share_weighted():
+    cache = build_cache(make_cq("cq1", cohort="team", weight=2.0),
+                        make_cq("cq2", cohort="team"))
+    cache.add_or_update_workload(admitted_workload("w1", "cq1", 15_000))
+    cq1 = cache.cluster_queue("cq1")
+    assert cq1.dominant_resource_share() == (125, "cpu")
+
+
+def test_zero_weight_drs_is_max():
+    import sys
+    cache = build_cache(make_cq("cq1", cohort="team", weight=0.0),
+                        make_cq("cq2", cohort="team"))
+    cache.add_or_update_workload(admitted_workload("w1", "cq1", 15_000))
+    assert cache.cluster_queue("cq1").dominant_resource_share()[0] == sys.maxsize
+
+
+def test_inactive_on_missing_flavor():
+    cache = Cache()
+    cache.add_or_update_cluster_queue(make_cq("cq1"))
+    assert not cache.cluster_queue("cq1").active
+    cache.add_or_update_resource_flavor(ResourceFlavor(name="default"))
+    assert cache.cluster_queue("cq1").active
+    snap_inactive = Cache()
+    snap_inactive.add_or_update_cluster_queue(make_cq("cq1"))
+    assert "cq1" in snap_inactive.snapshot().inactive_cluster_queues
+
+
+def test_readmission_to_different_cq_moves_usage():
+    cache = build_cache(make_cq("cq1", cohort="team"), make_cq("cq2", cohort="team"))
+    info = admitted_workload("w1", "cq1", 4_000)
+    cache.add_or_update_workload(info)
+    moved = admitted_workload("w1", "cq2", 4_000)
+    cache.add_or_update_workload(moved)
+    assert cache.cluster_queue("cq1").resource_node.usage.get(FR, 0) == 0
+    assert "default/w1" not in cache.cluster_queue("cq1").workloads
+    assert cache.cluster_queue("cq2").resource_node.usage.get(FR, 0) == 4_000
+
+
+def test_assume_after_add_does_not_double_count():
+    cache = build_cache(make_cq("cq1"))
+    info = admitted_workload("w1", "cq1", 4_000)
+    cache.add_or_update_workload(info)
+    assert not cache.assume_workload(info)
+    assert cache.cluster_queue("cq1").resource_node.usage.get(FR, 0) == 4_000
+
+
+def test_quota_queries_survive_cohort_cycle():
+    from kueue_tpu.api.types import Cohort
+    cache = Cache()
+    cache.add_or_update_resource_flavor(ResourceFlavor(name="default"))
+    cache.add_or_update_cohort(Cohort(name="a", parent_name="b"))
+    cache.add_or_update_cohort(Cohort(name="b", parent_name="a"))
+    cache.add_or_update_cluster_queue(make_cq("cq1", cohort="a"))
+    cq = cache.cluster_queue("cq1")
+    assert not cq.active
+    cq.available(FR)  # must not recurse forever
+    cq.dominant_resource_share()
+
+
+def test_scaled_to_does_not_alias_requests():
+    info = admitted_workload("w1", "cq1", 4_000)
+    psr = info.total_requests[0]
+    copy = psr.scaled_to(psr.count)
+    copy.requests.mul(2)
+    assert psr.requests == {"cpu": 4_000}

@@ -1,0 +1,55 @@
+from kueue_tpu import hierarchy
+
+
+def make_manager():
+    return hierarchy.Manager(cohort_factory=lambda name: {"name": name})
+
+
+def test_cq_attach_detach():
+    m = make_manager()
+    m.add_cluster_queue("cq1", object())
+    m.update_cluster_queue_edge("cq1", "team-a")
+    assert "team-a" in m.cohorts
+    assert "cq1" in m.cohorts["team-a"].child_cqs
+    m.update_cluster_queue_edge("cq1", None)
+    # implicit cohort garbage-collected once childless
+    assert "team-a" not in m.cohorts
+
+
+def test_explicit_cohort_survives_gc():
+    m = make_manager()
+    m.add_cohort("root")
+    m.add_cluster_queue("cq1", object())
+    m.update_cluster_queue_edge("cq1", "root")
+    m.update_cluster_queue_edge("cq1", None)
+    assert "root" in m.cohorts
+    m.delete_cohort("root")
+    assert "root" not in m.cohorts
+
+
+def test_cohort_tree_and_roots():
+    m = make_manager()
+    m.update_cohort_edge("child-a", "root")
+    m.update_cohort_edge("child-b", "root")
+    m.add_cluster_queue("cq1", object())
+    m.update_cluster_queue_edge("cq1", "child-a")
+    roots = m.roots()
+    assert [r.name for r in roots] == ["root"]
+    assert {n.name for n in roots[0].walk_subtree()} == {"root", "child-a", "child-b"}
+
+
+def test_reparenting():
+    m = make_manager()
+    m.update_cohort_edge("a", "p1")
+    m.update_cohort_edge("a", "p2")
+    assert "p1" not in m.cohorts  # implicit, now childless
+    assert m.cohorts["a"].parent.name == "p2"
+
+
+def test_cycle_detection():
+    m = make_manager()
+    m.update_cohort_edge("a", "b")
+    m.update_cohort_edge("b", "a")
+    assert hierarchy.has_cycle(m.cohorts["a"])
+    m.update_cohort_edge("b", None)
+    assert not hierarchy.has_cycle(m.cohorts["a"])

@@ -1,0 +1,52 @@
+import pytest
+
+from kueue_tpu.api.quantity import format_milli, parse_quantity
+
+
+@pytest.mark.parametrize("text,milli", [
+    ("1", 1000),
+    ("100m", 100),
+    ("1500m", 1500),
+    ("2.5", 2500),
+    ("0.1", 100),
+    ("1e3", 1_000_000),
+    ("2k", 2_000_000),
+])
+def test_parse_cpu_milli(text, milli):
+    assert parse_quantity(text, milli=True) == milli
+
+
+@pytest.mark.parametrize("text,value", [
+    ("1Ki", 1024),
+    ("1Mi", 1024**2),
+    ("2Gi", 2 * 1024**3),
+    ("1G", 10**9),
+    ("128974848", 128974848),
+    ("129e6", 129_000_000),
+    ("123Mi", 123 * 1024**2),
+])
+def test_parse_memory_units(text, value):
+    assert parse_quantity(text, milli=False) == value
+
+
+def test_rounds_up_to_whole_units():
+    # 1500m memory -> Value() rounds up to 2
+    assert parse_quantity("1500m", milli=False) == 2
+
+
+def test_int_float_passthrough():
+    assert parse_quantity(3, milli=True) == 3000
+    assert parse_quantity(0.5, milli=True) == 500
+    assert parse_quantity(5, milli=False) == 5
+
+
+def test_invalid():
+    with pytest.raises(ValueError):
+        parse_quantity("abc")
+    with pytest.raises(ValueError):
+        parse_quantity("1Q")
+
+
+def test_format_milli():
+    assert format_milli(1000) == "1"
+    assert format_milli(1500) == "1500m"
