@@ -1,0 +1,164 @@
+"""Per-ClusterQueue pending-workload queue.
+
+Capability parity with reference pkg/queue/cluster_queue.go:53: an active
+heap ordered by (priority desc, queue-order timestamp asc), an
+``inadmissible`` parking lot for BestEffortFIFO, an inflight slot for the
+workload currently in a scheduling cycle, and requeue-backoff gating.
+"""
+
+from __future__ import annotations
+
+import enum
+from typing import Callable, Optional
+
+from ..api.types import ConditionStatus, QueueingStrategy, WL_REQUEUED
+from ..utils.heap import Heap
+from ..workload import Info, Ordering
+
+
+class RequeueReason(str, enum.Enum):
+    FAILED_AFTER_NOMINATION = "FailedAfterNomination"
+    PENDING_PREEMPTION = "PendingPreemption"
+    NAMESPACE_MISMATCH = "NamespaceMismatch"
+    GENERIC = "Generic"
+
+
+def queue_ordering_less(ordering: Ordering) -> Callable[[Info, Info], bool]:
+    """reference cluster_queue.go:408 queueOrderingFunc."""
+    def less(a: Info, b: Info) -> bool:
+        if a.obj.priority != b.obj.priority:
+            return a.obj.priority > b.obj.priority
+        ta = ordering.queue_order_timestamp(a.obj)
+        tb = ordering.queue_order_timestamp(b.obj)
+        if ta != tb:
+            return ta < tb
+        return a.key < b.key  # deterministic total order on ties
+    return less
+
+
+class ClusterQueueQueue:
+    def __init__(self, name: str, strategy: QueueingStrategy,
+                 ordering: Ordering, clock: Callable[[], float]):
+        self.name = name
+        self.queueing_strategy = strategy
+        self.ordering = ordering
+        self.clock = clock
+        self.heap: Heap[Info] = Heap(key_fn=lambda i: i.key,
+                                     less=queue_ordering_less(ordering))
+        self.inadmissible: dict[str, Info] = {}
+        self.inflight: Optional[Info] = None
+        self.pop_cycle = 0
+        self.queue_inadmissible_cycle = -1
+        self.active = True  # mirrors CQ activeness (stop policies, missing refs)
+
+    # ------------------------------------------------------------------
+
+    def backoff_waiting_time_expired(self, info: Info) -> bool:
+        """reference cluster_queue.go:176."""
+        c = info.obj.conditions.get(WL_REQUEUED)
+        if c is not None and c.status == ConditionStatus.FALSE:
+            return False
+        rs = info.obj.requeue_state
+        if rs is None or rs.requeue_at is None:
+            return True
+        return self.clock() >= rs.requeue_at
+
+    def push_or_update(self, info: Info) -> None:
+        """reference cluster_queue.go PushOrUpdate (via AddOrUpdateWorkload)."""
+        key = info.key
+        self._forget_inflight(key)
+        old = self.inadmissible.pop(key, None)
+        if old is not None:
+            same = (old.obj.pod_sets == info.obj.pod_sets
+                    and old.obj.priority == info.obj.priority
+                    and old.obj.queue_name == info.obj.queue_name
+                    and old.obj.active == info.obj.active
+                    and old.obj.reclaimable_pods == info.obj.reclaimable_pods
+                    and old.obj.conditions.get("Evicted") == info.obj.conditions.get("Evicted")
+                    and old.obj.conditions.get(WL_REQUEUED) == info.obj.conditions.get(WL_REQUEUED))
+            if same:
+                self.inadmissible[key] = info
+                return
+        if self.heap.get(key) is None and not self.backoff_waiting_time_expired(info):
+            self.inadmissible[key] = info
+            return
+        self.heap.push_or_update(info)
+
+    def delete(self, key: str) -> None:
+        self.inadmissible.pop(key, None)
+        self.heap.delete(key)
+        self._forget_inflight(key)
+
+    def requeue_if_not_present(self, info: Info, reason: RequeueReason) -> bool:
+        """reference cluster_queue.go:225,402-406."""
+        if self.queueing_strategy == QueueingStrategy.STRICT_FIFO:
+            immediate = reason != RequeueReason.NAMESPACE_MISMATCH
+        else:
+            immediate = reason in (RequeueReason.FAILED_AFTER_NOMINATION,
+                                   RequeueReason.PENDING_PREEMPTION)
+        return self._requeue_if_not_present(info, immediate)
+
+    def _requeue_if_not_present(self, info: Info, immediate: bool) -> bool:
+        key = info.key
+        self._forget_inflight(key)
+        pending_flavors = (info.last_assignment is not None
+                           and getattr(info.last_assignment, "pending_flavors", False))
+        if self.backoff_waiting_time_expired(info) and (
+                immediate or self.queue_inadmissible_cycle >= self.pop_cycle
+                or pending_flavors):
+            parked = self.inadmissible.pop(key, None)
+            if parked is not None:
+                info = parked
+            return self.heap.push_if_not_present(info)
+        if key in self.inadmissible:
+            return False
+        if self.heap.get(key) is not None:
+            return False
+        self.inadmissible[key] = info
+        return True
+
+    def queue_inadmissible_workloads(self) -> bool:
+        """Move the parking lot back into the heap (reference
+        cluster_queue.go QueueInadmissibleWorkloads)."""
+        self.queue_inadmissible_cycle = self.pop_cycle
+        if not self.inadmissible:
+            return False
+        moved = False
+        still_waiting: dict[str, Info] = {}
+        for key, info in self.inadmissible.items():
+            if not self.backoff_waiting_time_expired(info):
+                still_waiting[key] = info
+                continue
+            if self.heap.push_if_not_present(info):
+                moved = True
+        self.inadmissible = still_waiting
+        return moved
+
+    def pop(self) -> Optional[Info]:
+        self.pop_cycle += 1
+        info = self.heap.pop()
+        self.inflight = info
+        return info
+
+    def _forget_inflight(self, key: str) -> None:
+        if self.inflight is not None and self.inflight.key == key:
+            self.inflight = None
+
+    # -- introspection --
+
+    def pending_active(self) -> int:
+        return len(self.heap) + (1 if self.inflight is not None else 0)
+
+    def pending_inadmissible(self) -> int:
+        return len(self.inadmissible)
+
+    def pending(self) -> int:
+        return self.pending_active() + self.pending_inadmissible()
+
+    def snapshot_sorted(self) -> list[Info]:
+        """Heap contents in order, for visibility APIs."""
+        items = self.heap.items()
+        less = queue_ordering_less(self.ordering)
+        import functools
+        return sorted(items, key=functools.cmp_to_key(
+            lambda a, b: -1 if less(a, b) else (1 if less(b, a) else 0)))

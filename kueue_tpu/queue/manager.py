@@ -1,0 +1,275 @@
+"""Pending-workload queue manager.
+
+Capability parity with reference pkg/queue/manager.go:86: one queue per
+ClusterQueue wired into the cohort forest, LocalQueue routing, blocking
+``heads`` (sync.Cond equivalent), cohort-wide inadmissible wakeups
+(manager.go:490), and requeue with reasons.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from typing import Callable, Iterable, Optional
+
+from .. import hierarchy
+from ..api.types import ClusterQueue, LocalQueue, StopPolicy, Workload
+from ..workload import Info, InfoOptions, Ordering
+from .cluster_queue import ClusterQueueQueue, RequeueReason
+
+
+class _QueueCohort:
+    """Cohort payload for the queue-side hierarchy (wiring only)."""
+
+    def __init__(self, name: str):
+        self.name = name
+
+
+class Manager:
+    def __init__(self, ordering: Ordering | None = None,
+                 clock: Callable[[], float] = time.time,
+                 info_options: InfoOptions | None = None):
+        self._lock = threading.RLock()
+        self._cond = threading.Condition(self._lock)
+        self.ordering = ordering or Ordering()
+        self.clock = clock
+        self.info_options = info_options or InfoOptions()
+        self._mgr: hierarchy.Manager[ClusterQueueQueue, _QueueCohort] = (
+            hierarchy.Manager(_QueueCohort))
+        self.local_queues: dict[str, LocalQueue] = {}
+        self._lq_members: dict[str, set[str]] = {}  # lq key -> workload keys
+        self._wl_route: dict[str, str] = {}         # workload key -> lq key
+        self.stopped = False
+
+    # ------------------------------------------------------------------
+    # ClusterQueues / LocalQueues / Cohorts
+    # ------------------------------------------------------------------
+
+    def add_cluster_queue(self, spec: ClusterQueue) -> None:
+        with self._lock:
+            if spec.name in self._mgr.cluster_queues:
+                # Idempotent upsert: a resync must not drop queued workloads
+                # (the reference errors with errQueueAlreadyExists instead).
+                self.update_cluster_queue(spec)
+                return
+            q = ClusterQueueQueue(spec.name, spec.queueing_strategy,
+                                  self.ordering, self.clock)
+            q.active = spec.stop_policy == StopPolicy.NONE
+            self._mgr.add_cluster_queue(spec.name, q)
+            self._mgr.update_cluster_queue_edge(spec.name, spec.cohort)
+            self._cond.notify_all()
+
+    def update_cluster_queue(self, spec: ClusterQueue) -> None:
+        with self._lock:
+            q = self._mgr.cluster_queues.get(spec.name)
+            if q is None:
+                self.add_cluster_queue(spec)
+                return
+            q.queueing_strategy = spec.queueing_strategy
+            q.active = spec.stop_policy == StopPolicy.NONE
+            self._mgr.update_cluster_queue_edge(spec.name, spec.cohort)
+            if q.active:
+                q.queue_inadmissible_workloads()
+            self._cond.notify_all()
+
+    def delete_cluster_queue(self, name: str) -> None:
+        with self._lock:
+            self._mgr.delete_cluster_queue(name)
+
+    def set_cluster_queue_active(self, name: str, active: bool) -> None:
+        with self._lock:
+            q = self._mgr.cluster_queues.get(name)
+            if q is None:
+                return
+            q.active = active
+            if active:
+                q.queue_inadmissible_workloads()
+            self._cond.notify_all()
+
+    def update_cohort_edge(self, name: str, parent: Optional[str]) -> None:
+        with self._lock:
+            self._mgr.update_cohort_edge(name, parent)
+
+    def add_local_queue(self, lq: LocalQueue,
+                        existing_workloads: Iterable[Workload] = ()) -> None:
+        with self._lock:
+            self.local_queues[lq.key] = lq
+            self._lq_members.setdefault(lq.key, set())
+            for wl in existing_workloads:
+                self.add_or_update_workload(wl)
+
+    def delete_local_queue(self, lq_key: str) -> None:
+        with self._lock:
+            lq = self.local_queues.pop(lq_key, None)
+            members = self._lq_members.pop(lq_key, set())
+            if lq is None:
+                return
+            q = self._mgr.cluster_queues.get(lq.cluster_queue)
+            if q is not None:
+                for wkey in members:
+                    q.delete(wkey)
+
+    # ------------------------------------------------------------------
+    # Workloads
+    # ------------------------------------------------------------------
+
+    def _route(self, wl: Workload) -> Optional[ClusterQueueQueue]:
+        lq = self.local_queues.get(f"{wl.namespace}/{wl.queue_name}")
+        if lq is None or lq.stop_policy != StopPolicy.NONE:
+            return None
+        return self._mgr.cluster_queues.get(lq.cluster_queue)
+
+    def add_or_update_workload(self, wl: Workload) -> bool:
+        """reference manager.go AddOrUpdateWorkload / UpdateWorkload: a
+        queue-name change removes the entry from the old queue first."""
+        with self._lock:
+            self._remove_stale_route(wl)
+            if wl.is_finished or not wl.is_active or wl.admission is not None:
+                return False
+            q = self._route(wl)
+            if q is None:
+                return False
+            info = Info(wl, self.info_options)
+            q.push_or_update(info)
+            lq_key = f"{wl.namespace}/{wl.queue_name}"
+            self._lq_members.setdefault(lq_key, set()).add(wl.key)
+            self._wl_route[wl.key] = lq_key
+            self._cond.notify_all()
+            return True
+
+    def _remove_stale_route(self, wl: Workload) -> None:
+        old_lq_key = self._wl_route.get(wl.key)
+        if old_lq_key is None or old_lq_key == f"{wl.namespace}/{wl.queue_name}":
+            return
+        members = self._lq_members.get(old_lq_key)
+        if members is not None:
+            members.discard(wl.key)
+        old_lq = self.local_queues.get(old_lq_key)
+        if old_lq is not None:
+            old_q = self._mgr.cluster_queues.get(old_lq.cluster_queue)
+            if old_q is not None:
+                old_q.delete(wl.key)
+        del self._wl_route[wl.key]
+
+    def requeue_workload(self, info: Info, reason: RequeueReason) -> bool:
+        """reference manager.go:404 RequeueWorkload."""
+        with self._lock:
+            if info.obj.is_finished or not info.obj.is_active or info.obj.admission is not None:
+                return False
+            q = self._route(info.obj)
+            if q is None:
+                return False
+            inserted = q.requeue_if_not_present(info, reason)
+            if inserted:
+                self._cond.notify_all()
+            return inserted
+
+    def delete_workload(self, wl: Workload) -> None:
+        with self._lock:
+            # Remove via the recorded route (survives queue_name edits),
+            # falling back to the current queue name.
+            lq_key = self._wl_route.pop(wl.key, f"{wl.namespace}/{wl.queue_name}")
+            members = self._lq_members.get(lq_key)
+            if members is not None:
+                members.discard(wl.key)
+            lq = self.local_queues.get(lq_key)
+            if lq is not None:
+                q = self._mgr.cluster_queues.get(lq.cluster_queue)
+                if q is not None:
+                    q.delete(wl.key)
+
+    def qualified_name(self, wl: Workload) -> str:
+        return f"{wl.namespace}/{wl.queue_name}"
+
+    # ------------------------------------------------------------------
+    # Cohort-wide wakeups — reference manager.go:490
+    # ------------------------------------------------------------------
+
+    def queue_inadmissible_workloads(self, cq_names: Iterable[str]) -> None:
+        """Move parked workloads back for these CQs and everything sharing
+        their cohort trees (quota may have freed anywhere in the tree)."""
+        with self._lock:
+            names = set()
+            for name in cq_names:
+                names.add(name)
+                parent = self._mgr.cq_parent(name)
+                if parent is not None:
+                    for cq_name in (q.name for q in parent.root().subtree_cqs()):
+                        names.add(cq_name)
+            moved = False
+            for name in names:
+                q = self._mgr.cluster_queues.get(name)
+                if q is not None and q.queue_inadmissible_workloads():
+                    moved = True
+            if moved:
+                self._cond.notify_all()
+
+    def broadcast(self) -> None:
+        with self._lock:
+            self._cond.notify_all()
+
+    # ------------------------------------------------------------------
+    # Heads — reference manager.go:586
+    # ------------------------------------------------------------------
+
+    def heads_nonblocking(self) -> list[Info]:
+        with self._lock:
+            return self._collect_heads()
+
+    def heads(self, timeout: Optional[float] = None) -> list[Info]:
+        """Block until at least one head exists (reference manager.go:586)."""
+        deadline = None if timeout is None else self.clock() + timeout
+        with self._lock:
+            while not self.stopped:
+                out = self._collect_heads()
+                if out:
+                    return out
+                wait = None if deadline is None else max(0.0, deadline - self.clock())
+                if wait == 0.0:
+                    return []
+                self._cond.wait(timeout=wait if wait is not None else 1.0)
+                if deadline is not None and self.clock() >= deadline:
+                    return self._collect_heads()
+            return []
+
+    def stop(self) -> None:
+        with self._lock:
+            self.stopped = True
+            self._cond.notify_all()
+
+    def _collect_heads(self) -> list[Info]:
+        out = []
+        for q in self._mgr.cluster_queues.values():
+            if not q.active:
+                continue
+            info = q.pop()
+            if info is not None:
+                out.append(info)
+        return out
+
+    # ------------------------------------------------------------------
+    # Introspection / visibility
+    # ------------------------------------------------------------------
+
+    def queue_for(self, name: str) -> Optional[ClusterQueueQueue]:
+        return self._mgr.cluster_queues.get(name)
+
+    def pending_workloads(self, cq_name: str) -> int:
+        with self._lock:
+            q = self._mgr.cluster_queues.get(cq_name)
+            return q.pending() if q else 0
+
+    def pending_workloads_info(self, cq_name: str) -> list[Info]:
+        """Sorted pending list for the visibility API (reference
+        pkg/visibility pending_workloads_cq.go)."""
+        with self._lock:
+            q = self._mgr.cluster_queues.get(cq_name)
+            if q is None:
+                return []
+            out = q.snapshot_sorted()
+            if q.inflight is not None:
+                out.insert(0, q.inflight)
+            return out
+
+    def cluster_queue_names(self) -> list[str]:
+        return list(self._mgr.cluster_queues)

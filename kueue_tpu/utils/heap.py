@@ -1,0 +1,108 @@
+"""Key-deduplicating binary heap.
+
+Capability parity with reference pkg/util/heap: items are keyed; pushing an
+existing key updates it in place and re-sifts; delete by key is O(log n).
+"""
+
+from __future__ import annotations
+
+from typing import Callable, Generic, Optional, TypeVar
+
+T = TypeVar("T")
+
+
+class Heap(Generic[T]):
+    def __init__(self, key_fn: Callable[[T], str], less: Callable[[T, T], bool]):
+        self._key = key_fn
+        self._less = less
+        self._items: list[T] = []
+        self._index: dict[str, int] = {}
+
+    def __len__(self) -> int:
+        return len(self._items)
+
+    def keys(self) -> list[str]:
+        return list(self._index)
+
+    def get(self, key: str) -> Optional[T]:
+        idx = self._index.get(key)
+        return self._items[idx] if idx is not None else None
+
+    def items(self) -> list[T]:
+        return list(self._items)
+
+    def push_or_update(self, item: T) -> None:
+        key = self._key(item)
+        idx = self._index.get(key)
+        if idx is not None:
+            self._items[idx] = item
+            self._sift_up(idx)
+            self._sift_down(idx)
+        else:
+            self._items.append(item)
+            self._index[key] = len(self._items) - 1
+            self._sift_up(len(self._items) - 1)
+
+    def push_if_not_present(self, item: T) -> bool:
+        if self._key(item) in self._index:
+            return False
+        self.push_or_update(item)
+        return True
+
+    def peek(self) -> Optional[T]:
+        return self._items[0] if self._items else None
+
+    def pop(self) -> Optional[T]:
+        if not self._items:
+            return None
+        top = self._items[0]
+        self._remove_at(0)
+        return top
+
+    def delete(self, key: str) -> bool:
+        idx = self._index.get(key)
+        if idx is None:
+            return False
+        self._remove_at(idx)
+        return True
+
+    # -- internals --
+
+    def _remove_at(self, idx: int) -> None:
+        key = self._key(self._items[idx])
+        last = len(self._items) - 1
+        if idx != last:
+            self._swap(idx, last)
+        self._items.pop()
+        del self._index[key]
+        if idx < len(self._items):
+            self._sift_up(idx)
+            self._sift_down(idx)
+
+    def _swap(self, i: int, j: int) -> None:
+        self._items[i], self._items[j] = self._items[j], self._items[i]
+        self._index[self._key(self._items[i])] = i
+        self._index[self._key(self._items[j])] = j
+
+    def _sift_up(self, idx: int) -> None:
+        while idx > 0:
+            parent = (idx - 1) // 2
+            if self._less(self._items[idx], self._items[parent]):
+                self._swap(idx, parent)
+                idx = parent
+            else:
+                break
+
+    def _sift_down(self, idx: int) -> None:
+        n = len(self._items)
+        while True:
+            left, right = 2 * idx + 1, 2 * idx + 2
+            smallest = idx
+            if left < n and self._less(self._items[left], self._items[smallest]):
+                smallest = left
+            if right < n and self._less(self._items[right], self._items[smallest]):
+                smallest = right
+            if smallest == idx:
+                return
+            self._swap(idx, smallest)
+            idx = smallest

@@ -1,0 +1,208 @@
+"""Queue manager tests, mirroring reference pkg/queue semantics."""
+
+from kueue_tpu.api.types import (
+    ClusterQueue,
+    ConditionStatus,
+    LocalQueue,
+    PodSet,
+    QueueingStrategy,
+    RequeueState,
+    StopPolicy,
+    Workload,
+    WL_REQUEUED,
+)
+from kueue_tpu.queue import Manager, RequeueReason
+from kueue_tpu.workload import Info
+
+
+class FakeClock:
+    def __init__(self, now=1000.0):
+        self.t = now
+
+    def __call__(self):
+        return self.t
+
+
+def make_wl(name, queue="lq", priority=0, created=0.0):
+    return Workload(name=name, queue_name=queue, priority=priority,
+                    creation_time=created,
+                    pod_sets=[PodSet(name="main", count=1, requests={"cpu": 1000})])
+
+
+def setup_manager(strategy=QueueingStrategy.BEST_EFFORT_FIFO, clock=None):
+    m = Manager(clock=clock or FakeClock())
+    m.add_cluster_queue(ClusterQueue(name="cq", queueing_strategy=strategy,
+                                     cohort="team"))
+    m.add_local_queue(LocalQueue(name="lq", namespace="default", cluster_queue="cq"))
+    return m
+
+
+def test_heads_priority_then_fifo():
+    m = setup_manager()
+    m.add_or_update_workload(make_wl("low", priority=1, created=1.0))
+    m.add_or_update_workload(make_wl("high", priority=10, created=2.0))
+    m.add_or_update_workload(make_wl("older-high", priority=10, created=0.5))
+    heads = m.heads_nonblocking()
+    assert [i.obj.name for i in heads] == ["older-high"]
+    # next cycle pops the next-best head
+    assert [i.obj.name for i in m.heads_nonblocking()] == ["high"]
+    assert [i.obj.name for i in m.heads_nonblocking()] == ["low"]
+    assert m.heads_nonblocking() == []
+
+
+def test_one_head_per_cq_per_cycle():
+    m = setup_manager()
+    m.add_cluster_queue(ClusterQueue(name="cq2"))
+    m.add_local_queue(LocalQueue(name="lq2", namespace="default", cluster_queue="cq2"))
+    m.add_or_update_workload(make_wl("a", created=1.0))
+    m.add_or_update_workload(make_wl("b", queue="lq2", created=2.0))
+    m.add_or_update_workload(make_wl("c", created=3.0))
+    heads = m.heads_nonblocking()
+    assert sorted(i.obj.name for i in heads) == ["a", "b"]
+
+
+def test_best_effort_fifo_parks_inadmissible():
+    m = setup_manager()
+    m.add_or_update_workload(make_wl("w1"))
+    [info] = m.heads_nonblocking()
+    # generic requeue parks it; it does not come back on its own
+    assert m.requeue_workload(info, RequeueReason.GENERIC)
+    assert m.heads_nonblocking() == []
+    assert m.pending_workloads("cq") == 1
+    # a cohort event brings it back
+    m.queue_inadmissible_workloads(["cq"])
+    assert [i.obj.name for i in m.heads_nonblocking()] == ["w1"]
+
+
+def test_strict_fifo_requeues_immediately():
+    m = setup_manager(strategy=QueueingStrategy.STRICT_FIFO)
+    m.add_or_update_workload(make_wl("w1"))
+    [info] = m.heads_nonblocking()
+    assert m.requeue_workload(info, RequeueReason.GENERIC)
+    assert [i.obj.name for i in m.heads_nonblocking()] == ["w1"]
+
+
+def test_failed_after_nomination_requeues_immediately():
+    m = setup_manager()
+    m.add_or_update_workload(make_wl("w1"))
+    [info] = m.heads_nonblocking()
+    assert m.requeue_workload(info, RequeueReason.FAILED_AFTER_NOMINATION)
+    assert [i.obj.name for i in m.heads_nonblocking()] == ["w1"]
+
+
+def test_requeue_backoff_gates_insertion():
+    clock = FakeClock(1000.0)
+    m = setup_manager(strategy=QueueingStrategy.STRICT_FIFO, clock=clock)
+    wl = make_wl("w1")
+    wl.requeue_state = RequeueState(count=1, requeue_at=1060.0)
+    m.add_or_update_workload(wl)
+    # parked until the backoff expires even under StrictFIFO
+    assert m.heads_nonblocking() == []
+    clock.t = 1061.0
+    m.queue_inadmissible_workloads(["cq"])
+    assert [i.obj.name for i in m.heads_nonblocking()] == ["w1"]
+
+
+def test_requeued_condition_false_blocks():
+    m = setup_manager()
+    wl = make_wl("w1")
+    wl.set_condition(WL_REQUEUED, ConditionStatus.FALSE, reason="Deactivated")
+    m.add_or_update_workload(wl)
+    assert m.heads_nonblocking() == []
+
+
+def test_cohort_wakeup_spans_tree():
+    m = setup_manager()
+    m.add_cluster_queue(ClusterQueue(name="cq2", cohort="team"))
+    m.add_local_queue(LocalQueue(name="lq2", namespace="default", cluster_queue="cq2"))
+    m.add_or_update_workload(make_wl("w1", queue="lq2"))
+    [info] = m.heads_nonblocking()
+    m.requeue_workload(info, RequeueReason.GENERIC)
+    assert m.heads_nonblocking() == []
+    # event on sibling cq wakes the whole cohort
+    m.queue_inadmissible_workloads(["cq"])
+    assert [i.obj.name for i in m.heads_nonblocking()] == ["w1"]
+
+
+def test_admitted_or_inactive_not_queued():
+    m = setup_manager()
+    wl = make_wl("w1")
+    wl.active = False
+    assert not m.add_or_update_workload(wl)
+    from kueue_tpu.api.types import Admission
+    wl2 = make_wl("w2")
+    wl2.admission = Admission(cluster_queue="cq")
+    assert not m.add_or_update_workload(wl2)
+
+
+def test_stopped_local_queue_blocks_routing():
+    m = setup_manager()
+    m.add_local_queue(LocalQueue(name="lq-held", namespace="default",
+                                 cluster_queue="cq", stop_policy=StopPolicy.HOLD))
+    assert not m.add_or_update_workload(make_wl("w1", queue="lq-held"))
+
+
+def test_inactive_cq_produces_no_heads():
+    m = setup_manager()
+    m.add_or_update_workload(make_wl("w1"))
+    m.set_cluster_queue_active("cq", False)
+    assert m.heads_nonblocking() == []
+    m.set_cluster_queue_active("cq", True)
+    assert [i.obj.name for i in m.heads_nonblocking()] == ["w1"]
+
+
+def test_delete_workload():
+    m = setup_manager()
+    wl = make_wl("w1")
+    m.add_or_update_workload(wl)
+    m.delete_workload(wl)
+    assert m.heads_nonblocking() == []
+
+
+def test_blocking_heads_with_timeout():
+    clock = FakeClock()
+    m = setup_manager(clock=clock)
+    import threading
+
+    result = []
+
+    def producer():
+        m.add_or_update_workload(make_wl("late"))
+
+    t = threading.Timer(0.05, producer)
+    t.start()
+    heads = m.heads(timeout=5.0)
+    result = [i.obj.name for i in heads]
+    assert result == ["late"]
+
+
+def test_queue_name_change_moves_workload():
+    m = setup_manager()
+    m.add_cluster_queue(ClusterQueue(name="cq2"))
+    m.add_local_queue(LocalQueue(name="lq2", namespace="default", cluster_queue="cq2"))
+    wl = make_wl("w1")
+    m.add_or_update_workload(wl)
+    wl.queue_name = "lq2"
+    m.add_or_update_workload(wl)
+    heads = m.heads_nonblocking()
+    assert [i.obj.name for i in heads] == ["w1"]
+    assert m.heads_nonblocking() == []  # not duplicated in old queue
+    assert m.pending_workloads("cq") == 0
+
+
+def test_update_while_inflight_not_double_counted():
+    m = setup_manager()
+    wl = make_wl("w1")
+    m.add_or_update_workload(wl)
+    [info] = m.heads_nonblocking()  # w1 inflight
+    m.add_or_update_workload(wl)    # update event during scheduling
+    assert m.pending_workloads("cq") == 1
+    names = [i.obj.name for i in m.pending_workloads_info("cq")]
+    assert names == ["w1"]
+
+
+def test_add_existing_cq_preserves_queue():
+    m = setup_manager()
+    m.add_or_update_workload(make_wl("w1"))
+    m.add_cluster_queue(ClusterQueue(name="cq"))  # resync event
+    assert m.pending_workloads("cq") == 1
