@@ -158,6 +158,20 @@ class CQState:
         """reference clusterqueue_snapshot.go:133 Fits."""
         return all(qty <= self.available(fr) for fr, qty in usage.items())
 
+    def borrowing(self, fr: FlavorResource) -> bool:
+        """Usage above this node's own subtree quota for fr."""
+        return self.borrowing_with(fr, 0)
+
+    def simulate_usage_addition(self, usage: FlavorResourceQuantities):
+        """Apply usage, returning a revert closure (reference
+        clusterqueue_snapshot.go SimulateUsageAddition)."""
+        rn.apply_usage(self, usage, +1)
+        return lambda: rn.apply_usage(self, usage, -1)
+
+    def simulate_usage_removal(self, usage: FlavorResourceQuantities):
+        rn.apply_usage(self, usage, -1)
+        return lambda: rn.apply_usage(self, usage, +1)
+
     def borrowing_with(self, fr: FlavorResource, val: int) -> bool:
         """Would usage+val exceed this CQ's own subtree quota
         (reference clusterqueue_snapshot.go BorrowingWith)."""

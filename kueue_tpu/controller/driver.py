@@ -1,0 +1,322 @@
+"""The control-plane driver: event flow between store, cache, queues, scheduler.
+
+Capability parity with reference cmd/kueue/main.go wiring plus
+pkg/controller/core: a durable workload store (the CRD-status equivalent,
+§5.4 — restart replays the store), reconciler-equivalent event handlers
+keeping cache and queues in sync, admission application, eviction/requeue
+handling with backoff, stop policies, and workload finish.
+
+This is the single-process composition root.  The scheduler itself stays a
+pure function of (snapshot, heads); everything durable lives here.
+"""
+
+from __future__ import annotations
+
+import time
+from dataclasses import dataclass, field
+from typing import Callable, Optional
+
+from ..api.types import (
+    AdmissionCheck,
+    AdmissionCheckState,
+    ClusterQueue,
+    Cohort,
+    ConditionStatus,
+    LocalQueue,
+    ResourceFlavor,
+    StopPolicy,
+    Topology,
+    Workload,
+    EVICTED_BY_DEACTIVATION,
+    EVICTED_BY_PREEMPTION,
+    WL_ADMITTED,
+    WL_EVICTED,
+    WL_FINISHED,
+    WL_QUOTA_RESERVED,
+)
+from ..cache.cache import Cache
+from ..queue.manager import Manager as QueueManager
+from ..queue.cluster_queue import RequeueReason
+from ..scheduler.scheduler import Scheduler
+from ..workload import (
+    Info,
+    InfoOptions,
+    Ordering,
+    set_finished_condition,
+    set_requeued_condition,
+    sync_admitted_condition,
+    unset_quota_reservation,
+    update_requeue_state,
+)
+from .. import metrics
+
+
+@dataclass
+class WaitForPodsReadyConfig:
+    """reference apis/config/v1beta1 WaitForPodsReady (:216)."""
+    enable: bool = False
+    timeout_seconds: float = 300.0
+    block_admission: bool = False
+    requeuing_backoff_base_seconds: int = 60
+    requeuing_backoff_max_seconds: int = 3600
+    requeuing_backoff_limit_count: Optional[int] = None
+    requeuing_timestamp: str = "Eviction"
+
+
+class Driver:
+    """Single-process manager wiring (reference cmd/kueue/main.go:106)."""
+
+    def __init__(self, clock: Callable[[], float] = time.time,
+                 fair_sharing: bool = False,
+                 fs_preemption_strategies: list[str] | None = None,
+                 info_options: InfoOptions | None = None,
+                 wait_for_pods_ready: WaitForPodsReadyConfig | None = None,
+                 namespaces: Optional[dict[str, dict[str, str]]] = None,
+                 use_device_solver: bool = False):
+        self.clock = clock
+        self.wait_for_pods_ready = wait_for_pods_ready or WaitForPodsReadyConfig()
+        ordering = Ordering(
+            pods_ready_requeuing_timestamp=self.wait_for_pods_ready.requeuing_timestamp)
+        self.cache = Cache(info_options=info_options,
+                           fair_sharing_enabled=fair_sharing)
+        self.queues = QueueManager(ordering=ordering, clock=clock,
+                                   info_options=info_options)
+        self.scheduler = Scheduler(
+            self.queues, self.cache, fair_sharing=fair_sharing,
+            fs_preemption_strategies=fs_preemption_strategies,
+            ordering=ordering, clock=clock, namespaces=namespaces)
+        self.scheduler.apply_admission = self._apply_admission
+        self.scheduler.preemptor.apply_preemption = self._apply_preemption
+        # durable store: the CRD-status equivalent
+        self.workloads: dict[str, Workload] = {}
+        self.events: list[tuple[str, str, str]] = []  # (kind, key, note)
+        self.metrics = metrics.Registry()
+
+    # ------------------------------------------------------------------
+    # Resource plumbing (reconciler-equivalents)
+    # ------------------------------------------------------------------
+
+    def apply_resource_flavor(self, flavor: ResourceFlavor) -> None:
+        self.cache.add_or_update_resource_flavor(flavor)
+        self._wake_all()
+
+    def apply_topology(self, topology: Topology) -> None:
+        self.cache.add_or_update_topology(topology)
+        self._wake_all()
+
+    def apply_admission_check(self, check: AdmissionCheck) -> None:
+        self.cache.add_or_update_admission_check(check)
+        self._wake_all()
+
+    def apply_cluster_queue(self, spec: ClusterQueue) -> None:
+        self.cache.add_or_update_cluster_queue(spec)
+        self.queues.add_cluster_queue(spec)
+        self._sync_cq_activeness()
+        self.queues.queue_inadmissible_workloads([spec.name])
+        self.metrics.cluster_queue_status(spec.name,
+                                          self.cache.cluster_queue(spec.name).active)
+
+    def delete_cluster_queue(self, name: str) -> None:
+        self.cache.delete_cluster_queue(name)
+        self.queues.delete_cluster_queue(name)
+
+    def apply_cohort(self, spec: Cohort) -> None:
+        self.cache.add_or_update_cohort(spec)
+        self.queues.update_cohort_edge(spec.name, spec.parent_name)
+        self._wake_all()
+
+    def apply_local_queue(self, lq: LocalQueue) -> None:
+        self.cache.add_or_update_local_queue(lq)
+        self.queues.add_local_queue(lq)
+
+    def _sync_cq_activeness(self) -> None:
+        for name in self.cache.cluster_queue_names():
+            cq = self.cache.cluster_queue(name)
+            if cq is not None:
+                self.queues.set_cluster_queue_active(name, cq.active)
+
+    def _wake_all(self) -> None:
+        self._sync_cq_activeness()
+        self.queues.queue_inadmissible_workloads(self.cache.cluster_queue_names())
+
+    # ------------------------------------------------------------------
+    # Workload lifecycle (reference core/workload_controller.go)
+    # ------------------------------------------------------------------
+
+    def create_workload(self, wl: Workload) -> None:
+        if wl.creation_time == 0.0:
+            wl.creation_time = self.clock()
+        self.workloads[wl.key] = wl
+        self.queues.add_or_update_workload(wl)
+        self.metrics.pending_inc(wl)
+
+    def delete_workload(self, key: str) -> None:
+        wl = self.workloads.pop(key, None)
+        if wl is None:
+            return
+        self.queues.delete_workload(wl)
+        if wl.admission is not None:
+            self.cache.delete_workload(Info(wl))
+            self.queues.queue_inadmissible_workloads([wl.admission.cluster_queue])
+
+    def finish_workload(self, key: str, message: str = "Job finished") -> None:
+        """Quota release on completion (reference jobframework finished path)."""
+        wl = self.workloads.get(key)
+        if wl is None or wl.is_finished:
+            return
+        now = self.clock()
+        set_finished_condition(wl, "JobFinished", message, now)
+        if wl.admission is not None:
+            cq_name = wl.admission.cluster_queue
+            self.cache.delete_workload(Info(wl))
+            self.metrics.admitted_active_dec(cq_name)
+            self.queues.queue_inadmissible_workloads([cq_name])
+        self.queues.delete_workload(wl)
+
+    def deactivate_workload(self, key: str) -> None:
+        wl = self.workloads.get(key)
+        if wl is None:
+            return
+        wl.active = False
+        now = self.clock()
+        if wl.admission is not None:
+            self._evict(wl, EVICTED_BY_DEACTIVATION, "The workload is deactivated")
+        self.queues.delete_workload(wl)
+
+    def set_admission_check_state(self, key: str, check: str,
+                                  state: AdmissionCheckState,
+                                  message: str = "") -> None:
+        """Two-phase admission: external controllers flip check states
+        (reference workload_controller.go:409)."""
+        wl = self.workloads.get(key)
+        if wl is None or check not in wl.admission_check_states:
+            return
+        now = self.clock()
+        st = wl.admission_check_states[check]
+        st.state = state
+        st.message = message
+        st.last_transition_time = now
+        if state == AdmissionCheckState.READY:
+            if sync_admitted_condition(wl, now):
+                self.metrics.admitted_workload(
+                    wl.admission.cluster_queue if wl.admission else "",
+                    now - wl.creation_time)
+                if wl.admission is not None:
+                    info = Info(wl, self.cache.info_options)
+                    self.cache.add_or_update_workload(info)
+        elif state in (AdmissionCheckState.RETRY, AdmissionCheckState.REJECTED):
+            self._evict(wl, "AdmissionCheck", f"Admission check {check}: {state.value}")
+            if state == AdmissionCheckState.REJECTED:
+                self.deactivate_workload(key)
+
+    # ------------------------------------------------------------------
+    # Scheduler side-effects
+    # ------------------------------------------------------------------
+
+    def _apply_admission(self, new_wl: Workload) -> bool:
+        """The SSA apply-equivalent: land admission in the store
+        (reference scheduler.go applyAdmissionWithSSA)."""
+        cur = self.workloads.get(new_wl.key)
+        if cur is None or cur.is_finished or not cur.is_active:
+            return False
+        self.workloads[new_wl.key] = new_wl
+        self.queues.delete_workload(new_wl)
+        cq = new_wl.admission.cluster_queue
+        now = self.clock()
+        self.metrics.quota_reserved(cq, now - new_wl.creation_time)
+        if new_wl.is_admitted:
+            self.metrics.admitted_workload(cq, now - new_wl.creation_time)
+        self.events.append(("QuotaReserved", new_wl.key, cq))
+        return True
+
+    def _apply_preemption(self, info: Info, reason: str, message: str) -> None:
+        """Eviction by preemption: update store, release quota, requeue
+        (reference WorkloadReconciler eviction path)."""
+        wl = self.workloads.get(info.key)
+        if wl is None:
+            return
+        self._evict(wl, EVICTED_BY_PREEMPTION, message, preempted_reason=reason)
+        self.events.append(("Preempted", info.key, reason))
+
+    def _evict(self, wl: Workload, reason: str, message: str,
+               preempted_reason: str | None = None) -> None:
+        from ..workload import set_evicted_condition, set_preempted_condition
+        now = self.clock()
+        cq_name = wl.admission.cluster_queue if wl.admission else ""
+        set_evicted_condition(wl, reason, message, now)
+        if preempted_reason is not None:
+            set_preempted_condition(wl, preempted_reason, message, now)
+        # reset admission check states on eviction
+        for st in wl.admission_check_states.values():
+            st.state = AdmissionCheckState.PENDING
+        if wl.admission is not None:
+            self.cache.delete_workload(Info(wl))
+            unset_quota_reservation(wl, reason, message, now)
+        self.metrics.evicted(cq_name, reason)
+        # requeue: back into the pending queues
+        set_requeued_condition(wl, reason, message, True, now)
+        if wl.is_active:
+            self.queues.add_or_update_workload(wl)
+        if cq_name:
+            self.queues.queue_inadmissible_workloads([cq_name])
+
+    def evict_for_pods_ready_timeout(self, key: str) -> None:
+        """WaitForPodsReady timeout (reference workload_controller.go:546)."""
+        wl = self.workloads.get(key)
+        if wl is None or wl.admission is None:
+            return
+        cfg = self.wait_for_pods_ready
+        now = self.clock()
+        update_requeue_state(wl, cfg.requeuing_backoff_base_seconds,
+                             cfg.requeuing_backoff_max_seconds, now)
+        limit = cfg.requeuing_backoff_limit_count
+        if limit is not None and wl.requeue_state.count > limit:
+            self.deactivate_workload(key)
+            return
+        self._evict(wl, "PodsReadyTimeout",
+                    f"Exceeded the PodsReady timeout {cfg.timeout_seconds}s")
+
+    # ------------------------------------------------------------------
+    # Run loop
+    # ------------------------------------------------------------------
+
+    def schedule_once(self):
+        stats = self.scheduler.schedule()
+        self.metrics.admission_attempt(bool(stats.admitted), stats.duration_s)
+        return stats
+
+    def run_until_settled(self, max_cycles: int = 1000):
+        """Run cycles until a fixed point: no admissions/preemptions AND the
+        queue state fingerprint repeats (a cycle that merely parks a blocked
+        head still makes progress)."""
+        all_stats = []
+        prev_fp = None
+        for _ in range(max_cycles):
+            stats = self.schedule_once()
+            all_stats.append(stats)
+            if stats.admitted or stats.preempting:
+                prev_fp = None
+                continue
+            fp = self._queue_fingerprint()
+            if fp == prev_fp:
+                break
+            prev_fp = fp
+        return all_stats
+
+    def _queue_fingerprint(self):
+        out = []
+        for name in sorted(self.queues.cluster_queue_names()):
+            q = self.queues.queue_for(name)
+            out.append((name, tuple(sorted(q.heap.keys())),
+                        tuple(sorted(q.inadmissible))))
+        return tuple(out)
+
+    # -- introspection --
+
+    def admitted_keys(self) -> set[str]:
+        """Workloads currently holding quota (reserved and not finished)."""
+        return {k for k, wl in self.workloads.items()
+                if wl.condition_true(WL_QUOTA_RESERVED) and not wl.is_finished}
+
+    def workload(self, key: str) -> Optional[Workload]:
+        return self.workloads.get(key)

@@ -1,0 +1,163 @@
+"""Metrics registry: the Prometheus-series equivalent.
+
+Capability parity with reference pkg/metrics/metrics.go:62-386 (namespace
+``kueue_``): admission attempts/durations, pending/reserving/admitted
+counts, quota-reserved and admission wait times, evictions/preemptions with
+reason labels, per-CQ resource usage, weighted shares.  Values are plain
+Python numbers; ``render()`` emits Prometheus text exposition format so the
+series names stay wire-compatible.
+"""
+
+from __future__ import annotations
+
+import math
+from collections import defaultdict
+from dataclasses import dataclass, field
+
+
+def exponential_buckets(start: float, factor: float, count: int) -> list[float]:
+    """reference metrics.go:387 generateExponentialBuckets."""
+    return [start * factor**i for i in range(count)]
+
+
+ATTEMPT_BUCKETS = exponential_buckets(0.001, 2, 16)  # seconds
+WAIT_BUCKETS = exponential_buckets(1, 2, 14)
+
+
+@dataclass
+class Histogram:
+    buckets: list[float]
+    counts: list[int] = field(default_factory=list)
+    total: float = 0.0
+    n: int = 0
+
+    def __post_init__(self):
+        if not self.counts:
+            self.counts = [0] * (len(self.buckets) + 1)
+
+    def observe(self, value: float) -> None:
+        self.n += 1
+        self.total += value
+        for i, b in enumerate(self.buckets):
+            if value <= b:
+                self.counts[i] += 1
+                return
+        self.counts[-1] += 1
+
+    def quantile(self, q: float) -> float:
+        if self.n == 0:
+            return 0.0
+        target = math.ceil(q * self.n)
+        seen = 0
+        for i, c in enumerate(self.counts):
+            seen += c
+            if seen >= target:
+                return self.buckets[i] if i < len(self.buckets) else float("inf")
+        return float("inf")
+
+
+class Registry:
+    def __init__(self):
+        self.counters: dict[tuple, float] = defaultdict(float)
+        self.gauges: dict[tuple, float] = defaultdict(float)
+        self.histograms: dict[tuple, Histogram] = {}
+
+    # -- generic --
+
+    def inc(self, name: str, labels: tuple = (), value: float = 1.0) -> None:
+        self.counters[(name, *labels)] += value
+
+    def set_gauge(self, name: str, labels: tuple, value: float) -> None:
+        self.gauges[(name, *labels)] = value
+
+    def add_gauge(self, name: str, labels: tuple, delta: float) -> None:
+        self.gauges[(name, *labels)] += delta
+
+    def observe(self, name: str, labels: tuple, value: float,
+                buckets: list[float] = ATTEMPT_BUCKETS) -> None:
+        key = (name, *labels)
+        if key not in self.histograms:
+            self.histograms[key] = Histogram(buckets=buckets)
+        self.histograms[key].observe(value)
+
+    # -- kueue series (reference metrics.go) --
+
+    def admission_attempt(self, success: bool, duration_s: float) -> None:
+        result = "success" if success else "inadmissible"
+        self.inc("kueue_admission_attempts_total", (result,))
+        self.observe("kueue_admission_attempt_duration_seconds", (result,), duration_s)
+
+    def pending_inc(self, wl) -> None:
+        pass  # pending gauges are sampled from the queues (see sample_pending)
+
+    def sample_pending(self, queues) -> None:
+        for name in queues.cluster_queue_names():
+            q = queues.queue_for(name)
+            self.set_gauge("kueue_pending_workloads", (name, "active"),
+                           q.pending_active())
+            self.set_gauge("kueue_pending_workloads", (name, "inadmissible"),
+                           q.pending_inadmissible())
+
+    def quota_reserved(self, cq: str, wait_s: float) -> None:
+        self.inc("kueue_quota_reserved_workloads_total", (cq,))
+        self.observe("kueue_quota_reserved_wait_time_seconds", (cq,), wait_s,
+                     WAIT_BUCKETS)
+        self.add_gauge("kueue_reserving_active_workloads", (cq,), 1)
+
+    def admitted_workload(self, cq: str, wait_s: float) -> None:
+        self.inc("kueue_admitted_workloads_total", (cq,))
+        self.observe("kueue_admission_wait_time_seconds", (cq,), wait_s,
+                     WAIT_BUCKETS)
+        self.add_gauge("kueue_admitted_active_workloads", (cq,), 1)
+
+    def admitted_active_dec(self, cq: str) -> None:
+        self.add_gauge("kueue_admitted_active_workloads", (cq,), -1)
+        self.add_gauge("kueue_reserving_active_workloads", (cq,), -1)
+
+    def evicted(self, cq: str, reason: str) -> None:
+        self.inc("kueue_evicted_workloads_total", (cq, reason))
+
+    def preempted(self, preempting_cq: str, reason: str) -> None:
+        self.inc("kueue_preempted_workloads_total", (preempting_cq, reason))
+
+    def cluster_queue_status(self, cq: str, active: bool) -> None:
+        for status in ("pending", "active", "terminating"):
+            self.set_gauge("kueue_cluster_queue_status", (cq, status),
+                           1.0 if (status == "active") == active and status == "active"
+                           else 0.0)
+
+    def report_resource_usage(self, cq: str, flavor: str, resource: str,
+                              usage: float, nominal: float) -> None:
+        self.set_gauge("kueue_cluster_queue_resource_usage",
+                       (cq, flavor, resource), usage)
+        self.set_gauge("kueue_cluster_queue_resource_nominal_quota",
+                       (cq, flavor, resource), nominal)
+
+    def report_weighted_share(self, cq: str, share: float) -> None:
+        self.set_gauge("kueue_cluster_queue_weighted_share", (cq,), share)
+
+    def report_cohort_weighted_share(self, cohort: str, share: float) -> None:
+        self.set_gauge("kueue_cohort_weighted_share", (cohort,), share)
+
+    # -- exposition --
+
+    def render(self) -> str:
+        lines = []
+        for key, val in sorted(self.counters.items()):
+            name, *labels = key
+            lines.append(f"{name}{_fmt_labels(labels)} {val}")
+        for key, val in sorted(self.gauges.items()):
+            name, *labels = key
+            lines.append(f"{name}{_fmt_labels(labels)} {val}")
+        for key, h in sorted(self.histograms.items()):
+            name, *labels = key
+            lines.append(f"{name}_count{_fmt_labels(labels)} {h.n}")
+            lines.append(f"{name}_sum{_fmt_labels(labels)} {h.total}")
+        return "\n".join(lines) + "\n"
+
+
+def _fmt_labels(labels: list) -> str:
+    if not labels:
+        return ""
+    parts = ",".join(f'l{i}="{v}"' for i, v in enumerate(labels))
+    return "{" + parts + "}"

@@ -137,7 +137,8 @@ class ClusterQueueQueue:
     def pop(self) -> Optional[Info]:
         self.pop_cycle += 1
         info = self.heap.pop()
-        self.inflight = info
+        if info is not None:
+            self.inflight = info
         return info
 
     def _forget_inflight(self, key: str) -> None:

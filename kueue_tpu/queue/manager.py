@@ -125,6 +125,9 @@ class Manager:
         with self._lock:
             self._remove_stale_route(wl)
             if wl.is_finished or not wl.is_active or wl.admission is not None:
+                # A previously queued workload that became ineligible must
+                # leave the queue (reference manager.go UpdateWorkload).
+                self.delete_workload(wl)
                 return False
             q = self._route(wl)
             if q is None:
@@ -217,19 +220,25 @@ class Manager:
             return self._collect_heads()
 
     def heads(self, timeout: Optional[float] = None) -> list[Info]:
-        """Block until at least one head exists (reference manager.go:586)."""
-        deadline = None if timeout is None else self.clock() + timeout
+        """Block until at least one head exists (reference manager.go:586).
+
+        The timeout is wall-clock (condition-variable waits are real time
+        even when a fake clock drives queue ordering/backoff).
+        """
+        import time as _time
+        deadline = None if timeout is None else _time.monotonic() + timeout
         with self._lock:
             while not self.stopped:
                 out = self._collect_heads()
                 if out:
                     return out
-                wait = None if deadline is None else max(0.0, deadline - self.clock())
-                if wait == 0.0:
+                if deadline is None:
+                    self._cond.wait(timeout=1.0)
+                    continue
+                wait = deadline - _time.monotonic()
+                if wait <= 0.0:
                     return []
-                self._cond.wait(timeout=wait if wait is not None else 1.0)
-                if deadline is not None and self.clock() >= deadline:
-                    return self._collect_heads()
+                self._cond.wait(timeout=wait)
             return []
 
     def stop(self) -> None:

@@ -1,0 +1,9 @@
+from .flavorassigner import (  # noqa: F401
+    Assignment,
+    AssignmentClusterQueueState,
+    FlavorAssigner,
+    Mode,
+    PodSetReducer,
+)
+from .preemption import Preemptor, PreemptionOracle, Target  # noqa: F401
+from .scheduler import CycleStats, Entry, EntryStatus, Scheduler  # noqa: F401

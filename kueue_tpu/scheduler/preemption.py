@@ -1,0 +1,406 @@
+"""Preemption target selection and eviction issuance.
+
+Capability parity with reference pkg/scheduler/preemption/preemption.go:
+candidate discovery honoring withinClusterQueue / reclaimWithinCohort /
+borrowWithinCohort policies (findCandidates :480), candidate ordering
+(:591), greedy minimal-preemption simulation with fill-back (:275-342),
+fair-sharing preemption with S2-a/S2-b strategies (:372-442), and the
+reclaim oracle used by the flavor assigner (preemption_oracle.go:40).
+"""
+
+from __future__ import annotations
+
+import time
+from dataclasses import dataclass, field
+from typing import Callable, Optional
+
+from ..api.types import (
+    BorrowWithinCohortPolicy,
+    ConditionStatus,
+    ReclaimWithinCohort,
+    WithinClusterQueue,
+    IN_CLUSTER_QUEUE_REASON,
+    IN_COHORT_FAIR_SHARING_REASON,
+    IN_COHORT_RECLAIM_WHILE_BORROWING_REASON,
+    IN_COHORT_RECLAMATION_REASON,
+    WL_EVICTED,
+    WL_QUOTA_RESERVED,
+)
+from ..cache.snapshot import Snapshot
+from ..cache.state import CQState
+from ..resources import FlavorResource, FlavorResourceQuantities
+from ..workload import Info, Ordering
+from . import fairsharing
+from .flavorassigner import Assignment, Mode
+
+
+@dataclass
+class Target:
+    info: Info
+    reason: str
+
+
+@dataclass
+class _PreemptionCtx:
+    preemptor: Info
+    preemptor_cq: CQState
+    snapshot: Snapshot
+    frs_need_preemption: set[FlavorResource]
+    workload_usage: FlavorResourceQuantities
+    tas_requests: object = None
+
+
+HUMAN_READABLE_REASONS = {
+    IN_CLUSTER_QUEUE_REASON: "prioritization in the ClusterQueue",
+    IN_COHORT_RECLAMATION_REASON: "reclamation within the cohort",
+    IN_COHORT_FAIR_SHARING_REASON: "Fair Sharing within the cohort",
+    IN_COHORT_RECLAIM_WHILE_BORROWING_REASON:
+        "reclamation within the cohort while borrowing",
+}
+
+
+def _quota_reservation_time(info: Info, now: float) -> float:
+    c = info.obj.conditions.get(WL_QUOTA_RESERVED)
+    if c is None or c.status != ConditionStatus.TRUE:
+        return now
+    return c.last_transition_time
+
+
+def candidates_ordering_key(cq_name: str, now: float):
+    """reference preemption.go:591 candidatesOrdering: evicted first, then
+    other-CQ borrowers, then lower priority, then later admission."""
+    def key(info: Info):
+        evicted = 0 if info.obj.condition_true(WL_EVICTED) else 1
+        in_cq = 1 if info.cluster_queue == cq_name else 0
+        return (evicted, in_cq, info.obj.priority,
+                -_quota_reservation_time(info, now), info.obj.uid)
+    return key
+
+
+def flavor_resources_need_preemption(assignment: Assignment) -> set[FlavorResource]:
+    """reference preemption.go:466."""
+    out = set()
+    for ps in assignment.pod_sets:
+        for res, fa in ps.flavors.items():
+            if fa.mode == Mode.PREEMPT:
+                out.add(FlavorResource(fa.name, res))
+    return out
+
+
+def _workload_uses_resources(info: Info, frs: set[FlavorResource]) -> bool:
+    for psr in info.total_requests:
+        for res, flavor in psr.flavors.items():
+            if FlavorResource(flavor, res) in frs:
+                return True
+    return False
+
+
+def _cq_is_borrowing(cq: CQState, frs: set[FlavorResource]) -> bool:
+    if not cq.has_parent():
+        return False
+    return any(cq.borrowing(fr) for fr in frs)
+
+
+class Preemptor:
+    """reference preemption.go Preemptor."""
+
+    def __init__(self, enable_fair_sharing: bool = False,
+                 fs_strategies: list[str] | None = None,
+                 ordering: Ordering | None = None,
+                 clock: Callable[[], float] = time.time):
+        self.enable_fair_sharing = enable_fair_sharing
+        self.fs_strategies = fairsharing.parse_strategies(fs_strategies)
+        self.ordering = ordering or Ordering()
+        self.clock = clock
+        # Pluggable apply hook (reference OverrideApply, preemption.go:96):
+        # called with (target Info, reason, message) when issuing evictions.
+        self.apply_preemption: Optional[Callable[[Info, str, str], None]] = None
+
+    # ------------------------------------------------------------------
+    # Target selection — reference preemption.go:127-191
+    # ------------------------------------------------------------------
+
+    def get_targets(self, wl: Info, assignment: Assignment,
+                    snapshot: Snapshot) -> list[Target]:
+        cq = snapshot.cq(wl.cluster_queue)
+        ctx = _PreemptionCtx(
+            preemptor=wl,
+            preemptor_cq=cq,
+            snapshot=snapshot,
+            frs_need_preemption=flavor_resources_need_preemption(assignment),
+            workload_usage=assignment.total_requests_for(wl),
+        )
+        return self._get_targets(ctx)
+
+    def _get_targets(self, ctx: _PreemptionCtx) -> list[Target]:
+        candidates = self._find_candidates(ctx)
+        if not candidates:
+            return []
+        candidates.sort(key=candidates_ordering_key(ctx.preemptor_cq.name,
+                                                    self.clock()))
+        if self.enable_fair_sharing:
+            return self._fair_preemptions(ctx, candidates)
+
+        same_queue = [c for c in candidates
+                      if c.cluster_queue == ctx.preemptor_cq.name]
+
+        if len(same_queue) == len(candidates):
+            # no cross-queue candidates: try borrowing
+            return self._minimal_preemptions(ctx, candidates, True, None)
+
+        borrow_ok, threshold = self._can_borrow_within_cohort(ctx)
+        if borrow_ok:
+            if not self._queue_under_nominal(ctx):
+                candidates = [c for c in candidates
+                              if c.cluster_queue == ctx.preemptor_cq.name
+                              or c.obj.priority < threshold]
+            return self._minimal_preemptions(ctx, candidates, True, threshold)
+
+        if self._queue_under_nominal(ctx):
+            targets = self._minimal_preemptions(ctx, candidates, False, None)
+            if targets:
+                return targets
+
+        return self._minimal_preemptions(ctx, same_queue, True, None)
+
+    def _can_borrow_within_cohort(self, ctx: _PreemptionCtx
+                                  ) -> tuple[bool, Optional[int]]:
+        """reference preemption.go:194 canBorrowWithinCohort."""
+        bwc = ctx.preemptor_cq.preemption.borrow_within_cohort
+        if bwc.policy == BorrowWithinCohortPolicy.NEVER:
+            return False, None
+        threshold = ctx.preemptor.obj.priority
+        if (bwc.max_priority_threshold is not None
+                and bwc.max_priority_threshold < threshold):
+            threshold = bwc.max_priority_threshold + 1
+        return True, threshold
+
+    def _queue_under_nominal(self, ctx: _PreemptionCtx) -> bool:
+        """reference preemption.go queueUnderNominalInResourcesNeedingPreemption."""
+        cq = ctx.preemptor_cq
+        for fr in ctx.frs_need_preemption:
+            quota = cq.resource_node.quotas.get(fr)
+            nominal = quota.nominal if quota else 0
+            if cq.resource_node.usage.get(fr, 0) >= nominal:
+                return False
+        return True
+
+    # ------------------------------------------------------------------
+    # Candidates — reference preemption.go:480 findCandidates
+    # ------------------------------------------------------------------
+
+    def _find_candidates(self, ctx: _PreemptionCtx) -> list[Info]:
+        cq = ctx.preemptor_cq
+        wl = ctx.preemptor
+        candidates: list[Info] = []
+        wl_priority = wl.obj.priority
+
+        if cq.preemption.within_cluster_queue != WithinClusterQueue.NEVER:
+            consider_same_prio = (cq.preemption.within_cluster_queue
+                                  == WithinClusterQueue.LOWER_OR_NEWER_EQUAL_PRIORITY)
+            preemptor_ts = self.ordering.queue_order_timestamp(wl.obj)
+            for cand in cq.workloads.values():
+                if cand.obj.priority > wl_priority:
+                    continue
+                if cand.obj.priority == wl_priority and not (
+                        consider_same_prio and preemptor_ts
+                        < self.ordering.queue_order_timestamp(cand.obj)):
+                    continue
+                if not _workload_uses_resources(cand, ctx.frs_need_preemption):
+                    continue
+                candidates.append(cand)
+
+        if cq.has_parent() and cq.preemption.reclaim_within_cohort != ReclaimWithinCohort.NEVER:
+            only_lower = cq.preemption.reclaim_within_cohort != ReclaimWithinCohort.ANY
+            for cohort_cq in cq.parent.root().subtree_cqs():
+                if cohort_cq is cq or not _cq_is_borrowing(cohort_cq, ctx.frs_need_preemption):
+                    continue
+                for cand in cohort_cq.workloads.values():
+                    if only_lower and cand.obj.priority >= wl_priority:
+                        continue
+                    if not _workload_uses_resources(cand, ctx.frs_need_preemption):
+                        continue
+                    candidates.append(cand)
+        return candidates
+
+    # ------------------------------------------------------------------
+    # Minimal preemptions — reference preemption.go:275-342
+    # ------------------------------------------------------------------
+
+    def _workload_fits(self, ctx: _PreemptionCtx, allow_borrowing: bool) -> bool:
+        """reference preemption.go:552 workloadFits."""
+        for fr, v in ctx.workload_usage.items():
+            if not allow_borrowing and ctx.preemptor_cq.borrowing_with(fr, v):
+                return False
+            if v > ctx.preemptor_cq.available(fr):
+                return False
+        return True
+
+    def _workload_fits_for_fair_sharing(self, ctx: _PreemptionCtx) -> bool:
+        revert = ctx.preemptor_cq.simulate_usage_removal(ctx.workload_usage)
+        res = self._workload_fits(ctx, True)
+        revert()
+        return res
+
+    def _minimal_preemptions(self, ctx: _PreemptionCtx, candidates: list[Info],
+                             allow_borrowing: bool,
+                             allow_borrowing_below_priority: Optional[int]
+                             ) -> list[Target]:
+        targets: list[Target] = []
+        fits = False
+        for cand in candidates:
+            cand_cq = ctx.snapshot.cq(cand.cluster_queue)
+            reason = IN_CLUSTER_QUEUE_REASON
+            if cand_cq is not ctx.preemptor_cq:
+                if not _cq_is_borrowing(cand_cq, ctx.frs_need_preemption):
+                    continue
+                reason = IN_COHORT_RECLAMATION_REASON
+                if allow_borrowing_below_priority is not None:
+                    if cand.obj.priority >= allow_borrowing_below_priority:
+                        # a target above the threshold disables borrowing;
+                        # safe because candidates are priority-ordered and
+                        # the last-added target survives fill-back
+                        allow_borrowing = False
+                    else:
+                        reason = IN_COHORT_RECLAIM_WHILE_BORROWING_REASON
+            ctx.snapshot.remove_workload(cand)
+            targets.append(Target(info=cand, reason=reason))
+            if self._workload_fits(ctx, allow_borrowing):
+                fits = True
+                break
+        if not fits:
+            self._restore(ctx.snapshot, targets)
+            return []
+        targets = self._fill_back(ctx, targets, allow_borrowing)
+        self._restore(ctx.snapshot, targets)
+        return targets
+
+    def _fill_back(self, ctx: _PreemptionCtx, targets: list[Target],
+                   allow_borrowing: bool) -> list[Target]:
+        """reference preemption.go:329 fillBackWorkloads."""
+        i = len(targets) - 2
+        while i >= 0:
+            ctx.snapshot.add_workload(targets[i].info)
+            if self._workload_fits(ctx, allow_borrowing):
+                targets[i] = targets[-1]
+                targets.pop()
+            else:
+                ctx.snapshot.remove_workload(targets[i].info)
+            i -= 1
+        return targets
+
+    @staticmethod
+    def _restore(snapshot: Snapshot, targets: list[Target]) -> None:
+        for t in targets:
+            snapshot.add_workload(t.info)
+
+    # ------------------------------------------------------------------
+    # Fair-sharing preemptions — reference preemption.go:372-460
+    # ------------------------------------------------------------------
+
+    def _fair_preemptions(self, ctx: _PreemptionCtx,
+                          candidates: list[Info]) -> list[Target]:
+        revert = ctx.preemptor_cq.simulate_usage_addition(ctx.workload_usage)
+        fits, targets, retry = self._run_first_fs_strategy(
+            ctx, candidates, self.fs_strategies[0])
+        if not fits and len(self.fs_strategies) > 1:
+            fits, targets = self._run_second_fs_strategy(retry, ctx, targets)
+        revert()
+        if not fits:
+            self._restore(ctx.snapshot, targets)
+            return []
+        targets = self._fill_back(ctx, targets, True)
+        self._restore(ctx.snapshot, targets)
+        return targets
+
+    def _run_first_fs_strategy(self, ctx: _PreemptionCtx, candidates: list[Info],
+                               strategy) -> tuple[bool, list[Target], list[Info]]:
+        ordering = fairsharing.TargetClusterQueueOrdering(
+            ctx.preemptor_cq, candidates, ctx.snapshot.cluster_queues)
+        targets: list[Target] = []
+        retry_candidates: list[Info] = []
+        for tcq in ordering.iterate():
+            if tcq.in_cluster_queue_preemption():
+                cand = tcq.pop_workload()
+                ctx.snapshot.remove_workload(cand)
+                targets.append(Target(info=cand, reason=IN_CLUSTER_QUEUE_REASON))
+                if self._workload_fits_for_fair_sharing(ctx):
+                    return True, targets, []
+                continue
+            preemptor_new, target_old = tcq.compute_shares()
+            while tcq.has_workload():
+                cand = tcq.pop_workload()
+                target_new = tcq.compute_target_share_after_removal(cand)
+                if strategy(preemptor_new, target_old, target_new):
+                    ctx.snapshot.remove_workload(cand)
+                    targets.append(Target(info=cand,
+                                          reason=IN_COHORT_FAIR_SHARING_REASON))
+                    if self._workload_fits_for_fair_sharing(ctx):
+                        return True, targets, []
+                    break  # re-pick CQ: shares changed
+                retry_candidates.append(cand)
+        return False, targets, retry_candidates
+
+    def _run_second_fs_strategy(self, retry_candidates: list[Info],
+                                ctx: _PreemptionCtx, targets: list[Target]
+                                ) -> tuple[bool, list[Target]]:
+        ordering = fairsharing.TargetClusterQueueOrdering(
+            ctx.preemptor_cq, retry_candidates, ctx.snapshot.cluster_queues)
+        for tcq in ordering.iterate():
+            preemptor_new, target_old = tcq.compute_shares()
+            if fairsharing.less_than_initial_share(preemptor_new, target_old, 0):
+                cand = tcq.pop_workload()
+                ctx.snapshot.remove_workload(cand)
+                targets.append(Target(info=cand,
+                                      reason=IN_COHORT_FAIR_SHARING_REASON))
+                if self._workload_fits_for_fair_sharing(ctx):
+                    return True, targets
+            ordering.drop_queue(tcq)
+        return False, targets
+
+    # ------------------------------------------------------------------
+    # Issuance — reference preemption.go:232-257
+    # ------------------------------------------------------------------
+
+    def issue_preemptions(self, preemptor: Info, targets: list[Target]) -> int:
+        from ..workload import set_evicted_condition, set_preempted_condition
+        from ..api.types import EVICTED_BY_PREEMPTION
+        count = 0
+        now = self.clock()
+        for t in targets:
+            if not t.info.obj.condition_true(WL_EVICTED):
+                message = (f"Preempted to accommodate a workload (UID: "
+                           f"{preemptor.obj.uid}) due to "
+                           f"{HUMAN_READABLE_REASONS.get(t.reason, 'UNKNOWN')}")
+                if self.apply_preemption is not None:
+                    self.apply_preemption(t.info, t.reason, message)
+                else:
+                    set_evicted_condition(t.info.obj, EVICTED_BY_PREEMPTION,
+                                          message, now)
+                    set_preempted_condition(t.info.obj, t.reason, message, now)
+            count += 1
+        return count
+
+
+class PreemptionOracle:
+    """reference preemption_oracle.go:40."""
+
+    def __init__(self, preemptor: Preemptor, snapshot: Snapshot):
+        self.preemptor = preemptor
+        self.snapshot = snapshot
+
+    def is_reclaim_possible(self, cq: CQState, wl: Info,
+                            fr: FlavorResource, quantity: int) -> bool:
+        if cq.borrowing_with(fr, quantity):
+            return False
+        ctx = _PreemptionCtx(
+            preemptor=wl,
+            preemptor_cq=self.snapshot.cq(wl.cluster_queue) or cq,
+            snapshot=self.snapshot,
+            frs_need_preemption={fr},
+            workload_usage=FlavorResourceQuantities({fr: quantity}),
+        )
+        for target in self.preemptor._get_targets(ctx):
+            if target.info.cluster_queue == cq.name:
+                return False
+        return True

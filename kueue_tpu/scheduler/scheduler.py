@@ -1,0 +1,472 @@
+"""The admission loop: one scheduling cycle over (heads, snapshot).
+
+Capability parity with reference pkg/scheduler/scheduler.go:176 schedule():
+① pop queue heads ② snapshot the cache ③ nominate (validate + flavor
+assignment + preemption targets, :336) ④ order entries — classical sort
+(:567) or fair-sharing tournament (fair_sharing_iterator.go) ⑤ sequential
+admit loop with within-cycle usage mutation, capacity reservation for
+preempt-with-no-targets (:383), overlapping-preemption skips, fits re-check
+⑥ requeue the rest.
+
+The cycle is a pure function of (snapshot, heads) plus the assume/apply
+side effects — exactly the boundary the batched TPU solver
+(kueue_tpu.ops.cycle) reproduces.
+"""
+
+from __future__ import annotations
+
+import time
+from dataclasses import dataclass, field
+from typing import Callable, Optional
+
+from ..api.types import (
+    Admission,
+    AdmissionCheckState,
+    AdmissionCheckStatus,
+    Workload,
+)
+from ..cache.cache import Cache
+from ..cache.snapshot import Snapshot
+from ..cache.state import CQState, dominant_resource_share
+from ..queue.cluster_queue import RequeueReason
+from ..queue.manager import Manager as QueueManager
+from ..resources import FlavorResourceQuantities
+from ..workload import (
+    Info,
+    Ordering,
+    set_quota_reservation,
+    sync_admitted_condition,
+)
+from .flavorassigner import (
+    Assignment,
+    FlavorAssigner,
+    Mode,
+    PodSetReducer,
+)
+from .preemption import Preemptor, PreemptionOracle, Target
+
+
+class EntryStatus:
+    NOT_NOMINATED = ""
+    NOMINATED = "nominated"
+    SKIPPED = "skipped"
+    ASSUMED = "assumed"
+
+
+@dataclass
+class Entry:
+    """reference scheduler.go:318 entry."""
+    info: Info
+    assignment: Assignment = field(default_factory=Assignment)
+    status: str = EntryStatus.NOT_NOMINATED
+    inadmissible_msg: str = ""
+    requeue_reason: RequeueReason = RequeueReason.GENERIC
+    preemption_targets: list[Target] = field(default_factory=list)
+    cq_snapshot: Optional[CQState] = None
+
+    @property
+    def obj(self) -> Workload:
+        return self.info.obj
+
+
+@dataclass
+class CycleStats:
+    cycle: int = 0
+    admitted: list[str] = field(default_factory=list)
+    preempting: list[str] = field(default_factory=list)
+    skipped: list[str] = field(default_factory=list)
+    inadmissible: list[str] = field(default_factory=list)
+    preempted_targets: list[str] = field(default_factory=list)
+    duration_s: float = 0.0
+
+
+class Scheduler:
+    """reference scheduler.go:64."""
+
+    def __init__(self, queues: QueueManager, cache: Cache,
+                 fair_sharing: bool = False,
+                 fs_preemption_strategies: list[str] | None = None,
+                 ordering: Ordering | None = None,
+                 clock: Callable[[], float] = time.time,
+                 partial_admission_enabled: bool = True,
+                 namespaces: Optional[dict[str, dict[str, str]]] = None,
+                 solver: Optional[object] = None):
+        self.queues = queues
+        self.cache = cache
+        self.fair_sharing = fair_sharing
+        self.ordering = ordering or Ordering()
+        self.clock = clock
+        self.partial_admission_enabled = partial_admission_enabled
+        self.namespaces = namespaces  # namespace -> labels (None: match all)
+        self.preemptor = Preemptor(
+            enable_fair_sharing=fair_sharing,
+            fs_strategies=fs_preemption_strategies,
+            ordering=self.ordering, clock=clock)
+        self.scheduling_cycle = 0
+        # Hook applied after assume; returns True on success (reference
+        # applyAdmission / admissionRoutineWrapper, scheduler.go:80,156).
+        self.apply_admission: Callable[[Workload], bool] = lambda wl: True
+        # Decision-record sink for requeue/update patches.
+        self.on_requeue: Callable[[Entry], None] = lambda e: None
+        # Optional batched device solver (kueue_tpu.ops.solver.CycleSolver).
+        self.solver = solver
+
+    # ------------------------------------------------------------------
+    # One cycle — reference scheduler.go:176
+    # ------------------------------------------------------------------
+
+    def schedule(self) -> CycleStats:
+        self.scheduling_cycle += 1
+        stats = CycleStats(cycle=self.scheduling_cycle)
+        start = self.clock()
+
+        heads = self.queues.heads_nonblocking()
+        if not heads:
+            return stats
+        snapshot = self.cache.snapshot()
+        entries = self.nominate(heads, snapshot)
+        iterator = self._make_iterator(entries, snapshot)
+
+        preempted_workloads: dict[str, Info] = {}
+        for e in iterator:
+            cq = snapshot.cq(e.info.cluster_queue)
+            mode = e.assignment.representative_mode()
+            if mode == Mode.NO_FIT:
+                continue
+
+            if mode == Mode.PREEMPT and not e.preemption_targets:
+                # reserve capacity so lower-priority entries can't jump ahead
+                if cq is not None:
+                    usage = self._resources_to_reserve(e, cq)
+                    cq.simulate_usage_addition(usage)  # revert discarded: snapshot-local
+                continue
+
+            if any(t.info.key in preempted_workloads for t in e.preemption_targets):
+                self._set_skipped(e, "Workload has overlapping preemption "
+                                     "targets with another workload")
+                continue
+
+            usage = e.assignment.usage
+            if not self._fits(cq, usage, preempted_workloads, e.preemption_targets):
+                self._set_skipped(e, "Workload no longer fits after "
+                                     "processing another workload")
+                continue
+            for t in e.preemption_targets:
+                preempted_workloads[t.info.key] = t.info
+            cq.simulate_usage_addition(usage)
+
+            if e.assignment.representative_mode() == Mode.PREEMPT:
+                e.info.last_assignment = None  # retry all flavors next time
+                preempted = self.preemptor.issue_preemptions(e.info, e.preemption_targets)
+                if preempted:
+                    e.inadmissible_msg += (f". Pending the preemption of "
+                                           f"{preempted} workload(s)")
+                    e.requeue_reason = RequeueReason.PENDING_PREEMPTION
+                stats.preempting.append(e.info.key)
+                stats.preempted_targets.extend(t.info.key for t in e.preemption_targets)
+                continue
+
+            e.status = EntryStatus.NOMINATED
+            if self._admit(e, cq):
+                stats.admitted.append(e.info.key)
+            else:
+                e.inadmissible_msg = "Failed to admit workload"
+
+        for e in entries:
+            if e.status != EntryStatus.ASSUMED:
+                self._requeue_and_update(e)
+                if e.status == EntryStatus.SKIPPED:
+                    stats.skipped.append(e.info.key)
+                else:
+                    stats.inadmissible.append(e.info.key)
+        stats.duration_s = self.clock() - start
+        return stats
+
+    # ------------------------------------------------------------------
+    # Nomination — reference scheduler.go:336
+    # ------------------------------------------------------------------
+
+    def nominate(self, heads: list[Info], snapshot: Snapshot) -> list[Entry]:
+        entries = []
+        for info in heads:
+            lq = self.queues.local_queues.get(
+                f"{info.obj.namespace}/{info.obj.queue_name}")
+            cq_name = lq.cluster_queue if lq else ""
+            info.cluster_queue = cq_name
+            e = Entry(info=info)
+            e.cq_snapshot = snapshot.cq(cq_name)
+            if info.key in self.cache.assumed_workloads or info.obj.is_admitted:
+                continue
+            if self._has_retry_or_rejected_checks(info.obj):
+                e.inadmissible_msg = "The workload has failed admission checks"
+            elif cq_name in snapshot.inactive_cluster_queues:
+                e.inadmissible_msg = f"ClusterQueue {cq_name} is inactive"
+            elif e.cq_snapshot is None:
+                e.inadmissible_msg = f"ClusterQueue {cq_name} not found"
+            elif not self._namespace_matches(e.cq_snapshot, info.obj.namespace):
+                e.inadmissible_msg = ("Workload namespace doesn't match "
+                                      "ClusterQueue selector")
+                e.requeue_reason = RequeueReason.NAMESPACE_MISMATCH
+            elif not self._validate_resources(info):
+                e.inadmissible_msg = "resource validation failed"
+            else:
+                e.assignment, e.preemption_targets = self._get_assignments(
+                    info, snapshot)
+                e.inadmissible_msg = e.assignment.message()
+                info.last_assignment = e.assignment.last_state
+            entries.append(e)
+        return entries
+
+    @staticmethod
+    def _has_retry_or_rejected_checks(wl: Workload) -> bool:
+        return any(st.state in (AdmissionCheckState.RETRY, AdmissionCheckState.REJECTED)
+                   for st in wl.admission_check_states.values())
+
+    def _namespace_matches(self, cq: CQState, namespace: str) -> bool:
+        selector = cq.spec.namespace_selector
+        if selector is None or not selector:
+            return True
+        if self.namespaces is None:
+            return True
+        labels = self.namespaces.get(namespace, {})
+        return all(labels.get(k) == v for k, v in selector.items())
+
+    @staticmethod
+    def _validate_resources(info: Info) -> bool:
+        return all(v >= 0 for psr in info.total_requests
+                   for v in psr.requests.values())
+
+    def _get_assignments(self, wl: Info, snapshot: Snapshot
+                         ) -> tuple[Assignment, list[Target]]:
+        """reference scheduler.go:415 getAssignments."""
+        cq = snapshot.cq(wl.cluster_queue)
+        oracle = PreemptionOracle(self.preemptor, snapshot)
+        assigner = FlavorAssigner(
+            wl, cq, snapshot.resource_flavors,
+            enable_fair_sharing=self.fair_sharing, oracle=oracle,
+            tas_flavors=snapshot.tas_flavors)
+        full = assigner.assign(None)
+        mode = full.representative_mode()
+        if mode == Mode.FIT:
+            return full, []
+        if mode == Mode.PREEMPT:
+            targets = self.preemptor.get_targets(wl, full, snapshot)
+            if targets:
+                return full, targets
+        if self.partial_admission_enabled and self._can_be_partially_admitted(wl):
+            def fits(counts: list[int]):
+                assignment = assigner.assign(counts)
+                m = assignment.representative_mode()
+                if m == Mode.FIT:
+                    return (assignment, []), True
+                if m == Mode.PREEMPT:
+                    targets = self.preemptor.get_targets(wl, assignment, snapshot)
+                    if targets:
+                        return (assignment, targets), True
+                return None, False
+            reducer = PodSetReducer(wl.obj.pod_sets, fits)
+            result, found = reducer.search()
+            if found and result is not None:
+                return result
+        return full, []
+
+    @staticmethod
+    def _can_be_partially_admitted(wl: Info) -> bool:
+        return any(ps.min_count is not None and ps.min_count < ps.count
+                   for ps in wl.obj.pod_sets)
+
+    # ------------------------------------------------------------------
+    # Iterators — reference scheduler.go:567-600 + fair_sharing_iterator.go
+    # ------------------------------------------------------------------
+
+    def _make_iterator(self, entries: list[Entry], snapshot: Snapshot):
+        if self.fair_sharing:
+            return self._fair_sharing_iterator(entries, snapshot)
+        return self._classical_iterator(entries)
+
+    def _classical_iterator(self, entries: list[Entry]):
+        def sort_key(e: Entry):
+            return (1 if e.assignment.borrows() else 0,
+                    -e.obj.priority,
+                    self.ordering.queue_order_timestamp(e.obj))
+        return iter(sorted(entries, key=sort_key))
+
+    def _fair_sharing_iterator(self, entries: list[Entry], snapshot: Snapshot):
+        """Per-cohort tournament minimizing post-admission DRS
+        (reference fair_sharing_iterator.go:121)."""
+        remaining: dict[str, Entry] = {
+            e.info.cluster_queue: e for e in entries if e.cq_snapshot is not None}
+        no_cq = [e for e in entries if e.cq_snapshot is None]
+        yield from no_cq
+
+        def compute_drs_values() -> dict[tuple[str, str], int]:
+            drs_values: dict[tuple[str, str], int] = {}
+            for cq_name, e in remaining.items():
+                cq = e.cq_snapshot
+                revert = cq.simulate_usage_addition(e.assignment.usage)
+                drs_values[(getattr(cq.parent, "name", ""), e.info.key)] = (
+                    dominant_resource_share(cq)[0])
+                cohort = cq.parent
+                while cohort is not None and cohort.parent is not None:
+                    drs_values[(cohort.parent.name, e.info.key)] = (
+                        dominant_resource_share(cohort)[0])
+                    cohort = cohort.parent
+                revert()
+            return drs_values
+
+        def less(a: Entry, b: Entry, parent: str, drs_values) -> bool:
+            a_drs = drs_values.get((parent, a.info.key), 0)
+            b_drs = drs_values.get((parent, b.info.key), 0)
+            if a_drs != b_drs:
+                return a_drs < b_drs
+            if a.obj.priority != b.obj.priority:
+                return a.obj.priority > b.obj.priority
+            return (self.ordering.queue_order_timestamp(a.obj)
+                    < self.ordering.queue_order_timestamp(b.obj))
+
+        def tournament(cohort, drs_values) -> Optional[Entry]:
+            candidates = []
+            for child in cohort.child_cohorts:
+                cand = tournament(child, drs_values)
+                if cand is not None:
+                    candidates.append(cand)
+            for cq in cohort.child_cqs:
+                cand = remaining.get(cq.name)
+                if cand is not None and cand.cq_snapshot is cq:
+                    candidates.append(cand)
+            if not candidates:
+                return None
+            best = candidates[0]
+            for cur in candidates[1:]:
+                if less(cur, best, cohort.name, drs_values):
+                    best = cur
+            return best
+
+        while remaining:
+            cq_name = next(iter(remaining))
+            cq = remaining[cq_name].cq_snapshot
+            if cq.parent is None:
+                yield remaining.pop(cq_name)
+                continue
+            drs_values = compute_drs_values()
+            winner = tournament(cq.parent.root(), drs_values)
+            if winner is None:
+                yield remaining.pop(cq_name)
+                continue
+            del remaining[winner.info.cluster_queue]
+            yield winner
+
+    # ------------------------------------------------------------------
+    # Admission mechanics
+    # ------------------------------------------------------------------
+
+    @staticmethod
+    def _fits(cq: CQState, usage: FlavorResourceQuantities,
+              preempted: dict[str, Info], new_targets: list[Target]) -> bool:
+        """reference scheduler.go:372 fits."""
+        workloads = list(preempted.values()) + [t.info for t in new_targets]
+        seen, unique = set(), []
+        for w in workloads:  # a target may already be in preempted
+            if w.key not in seen:
+                seen.add(w.key)
+                unique.append(w)
+        return _fits_with_removal(cq, usage, unique)
+
+    def _resources_to_reserve(self, e: Entry, cq: CQState) -> FlavorResourceQuantities:
+        """reference scheduler.go:383-408 resourcesToReserve."""
+        if e.assignment.representative_mode() != Mode.PREEMPT:
+            return e.assignment.usage
+        reserved = FlavorResourceQuantities()
+        for fr, usage in e.assignment.usage.items():
+            quota = cq.resource_node.quotas.get(fr)
+            nominal = quota.nominal if quota else 0
+            b_limit = quota.borrowing_limit if quota else None
+            cur = cq.resource_node.usage.get(fr, 0)
+            if e.assignment.borrowing:
+                if b_limit is None:
+                    reserved[fr] = usage
+                else:
+                    reserved[fr] = min(usage, nominal + b_limit - cur)
+            else:
+                reserved[fr] = max(0, min(usage, nominal - cur))
+        return reserved
+
+    @staticmethod
+    def _set_skipped(e: Entry, message: str) -> None:
+        e.status = EntryStatus.SKIPPED
+        e.inadmissible_msg = message
+        e.requeue_reason = RequeueReason.GENERIC
+
+    def _admit(self, e: Entry, cq: CQState) -> bool:
+        """reference scheduler.go:490 admit."""
+        now = self.clock()
+        new_wl = e.obj.clone()
+        admission = Admission(cluster_queue=e.info.cluster_queue,
+                              pod_set_assignments=e.assignment.to_api())
+        set_quota_reservation(new_wl, admission, now)
+        # initialize admission-check states required by the CQ
+        for check_name in self._checks_for(cq, e.assignment):
+            if check_name not in new_wl.admission_check_states:
+                new_wl.admission_check_states[check_name] = AdmissionCheckStatus(
+                    name=check_name, state=AdmissionCheckState.PENDING,
+                    last_transition_time=now)
+        sync_admitted_condition(new_wl, now)
+        new_info = Info(new_wl, self.cache.info_options)
+        new_info.cluster_queue = e.info.cluster_queue
+        if not self.cache.assume_workload(new_info):
+            return False
+        e.status = EntryStatus.ASSUMED
+        if not self.apply_admission(new_wl):
+            self.cache.forget_workload(new_info)
+            self._requeue_and_update(e)
+            return False
+        return True
+
+    def _checks_for(self, cq: CQState, assignment: Assignment) -> list[str]:
+        """AdmissionChecks + per-flavor strategy rules (reference
+        workload.AdmissionChecksForWorkload)."""
+        checks = list(cq.spec.admission_checks)
+        used_flavors = {fa.name for ps in assignment.pod_sets
+                        for fa in ps.flavors.values()}
+        for rule in cq.spec.admission_checks_strategy:
+            if not rule.on_flavors or used_flavors & set(rule.on_flavors):
+                if rule.name not in checks:
+                    checks.append(rule.name)
+        return checks
+
+    def _requeue_and_update(self, e: Entry) -> None:
+        """reference scheduler.go:636 requeueAndUpdate."""
+        if (e.status != EntryStatus.NOT_NOMINATED
+                and e.requeue_reason == RequeueReason.GENERIC):
+            e.requeue_reason = RequeueReason.FAILED_AFTER_NOMINATION
+        self.queues.requeue_workload(e.info, e.requeue_reason)
+        self.on_requeue(e)
+
+
+def _fits_with_removal(cq: CQState, usage: FlavorResourceQuantities,
+                       to_remove: list[Info]) -> bool:
+    """Simulate removing preempted workloads anywhere in the cohort tree,
+    then check Fits (reference scheduler.go:372-381)."""
+    if cq is None:
+        return False
+    # Find each workload's CQ within the same snapshot (walk the tree root).
+    removed: list[tuple[CQState, Info]] = []
+
+    def find_cq(info: Info) -> Optional[CQState]:
+        if cq.parent is not None:
+            for c in cq.parent.root().subtree_cqs():
+                if info.key in c.workloads:
+                    return c
+        if info.key in cq.workloads:
+            return cq
+        return None
+
+    for info in to_remove:
+        owner = find_cq(info)
+        if owner is not None:
+            owner.remove_workload(owner.workloads[info.key])
+            removed.append((owner, info))
+    fits = cq.fits(usage)
+    for owner, info in removed:
+        owner.add_workload(info)
+    return fits

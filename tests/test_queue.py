@@ -206,3 +206,29 @@ def test_add_existing_cq_preserves_queue():
     m.add_or_update_workload(make_wl("w1"))
     m.add_cluster_queue(ClusterQueue(name="cq"))  # resync event
     assert m.pending_workloads("cq") == 1
+
+
+def test_deactivation_update_removes_from_queue():
+    m = setup_manager()
+    wl = make_wl("w1")
+    m.add_or_update_workload(wl)
+    wl.active = False
+    m.add_or_update_workload(wl)  # deactivation update event
+    assert m.heads_nonblocking() == []
+    assert m.pending_workloads("cq") == 0
+
+
+def test_empty_pop_preserves_inflight():
+    m = setup_manager()
+    m.add_or_update_workload(make_wl("w1"))
+    [info] = m.heads_nonblocking()  # w1 inflight
+    assert m.heads_nonblocking() == []  # empty pop
+    assert m.pending_workloads("cq") == 1  # inflight still counted
+
+
+def test_heads_timeout_with_fake_clock():
+    m = setup_manager(clock=FakeClock())  # fake clock never advances
+    import time
+    start = time.monotonic()
+    assert m.heads(timeout=0.2) == []
+    assert time.monotonic() - start < 2.0  # returned on wall-clock timeout
