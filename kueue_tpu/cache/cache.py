@@ -217,6 +217,7 @@ class Cache:
                 inactive_cluster_queues=inactive,
                 resource_flavors=dict(self.resource_flavors),
                 tas_flavors=self.tas.snapshot(),
+                fair_sharing_enabled=self.fair_sharing_enabled,
             )
 
     # ------------------------------------------------------------------

@@ -85,6 +85,9 @@ class Driver:
             self.queues, self.cache, fair_sharing=fair_sharing,
             fs_preemption_strategies=fs_preemption_strategies,
             ordering=ordering, clock=clock, namespaces=namespaces)
+        if use_device_solver:
+            from ..ops.solver import CycleSolver
+            self.scheduler.solver = CycleSolver(ordering)
         self.scheduler.apply_admission = self._apply_admission
         self.scheduler.preemptor.apply_preemption = self._apply_preemption
         # durable store: the CRD-status equivalent
@@ -168,8 +171,11 @@ class Driver:
         set_finished_condition(wl, "JobFinished", message, now)
         if wl.admission is not None:
             cq_name = wl.admission.cluster_queue
+            was_admitted = wl.is_admitted
             self.cache.delete_workload(Info(wl))
-            self.metrics.admitted_active_dec(cq_name)
+            self.metrics.release_reservation(cq_name)
+            if was_admitted:
+                self.metrics.release_admitted(cq_name)
             self.queues.queue_inadmissible_workloads([cq_name])
         self.queues.delete_workload(wl)
 
@@ -250,7 +256,11 @@ class Driver:
         for st in wl.admission_check_states.values():
             st.state = AdmissionCheckState.PENDING
         if wl.admission is not None:
+            was_admitted = wl.is_admitted
             self.cache.delete_workload(Info(wl))
+            self.metrics.release_reservation(cq_name)
+            if was_admitted:
+                self.metrics.release_admitted(cq_name)
             unset_quota_reservation(wl, reason, message, now)
         self.metrics.evicted(cq_name, reason)
         # requeue: back into the pending queues

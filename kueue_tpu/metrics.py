@@ -110,9 +110,11 @@ class Registry:
                      WAIT_BUCKETS)
         self.add_gauge("kueue_admitted_active_workloads", (cq,), 1)
 
-    def admitted_active_dec(self, cq: str) -> None:
-        self.add_gauge("kueue_admitted_active_workloads", (cq,), -1)
+    def release_reservation(self, cq: str) -> None:
         self.add_gauge("kueue_reserving_active_workloads", (cq,), -1)
+
+    def release_admitted(self, cq: str) -> None:
+        self.add_gauge("kueue_admitted_active_workloads", (cq,), -1)
 
     def evicted(self, cq: str, reason: str) -> None:
         self.inc("kueue_evicted_workloads_total", (cq, reason))
@@ -121,10 +123,11 @@ class Registry:
         self.inc("kueue_preempted_workloads_total", (preempting_cq, reason))
 
     def cluster_queue_status(self, cq: str, active: bool) -> None:
+        """Exactly one status series is 1 (reference ReportClusterQueueStatus)."""
+        current = "active" if active else "pending"
         for status in ("pending", "active", "terminating"):
             self.set_gauge("kueue_cluster_queue_status", (cq, status),
-                           1.0 if (status == "active") == active and status == "active"
-                           else 0.0)
+                           1.0 if status == current else 0.0)
 
     def report_resource_usage(self, cq: str, flavor: str, resource: str,
                               usage: float, nominal: float) -> None:

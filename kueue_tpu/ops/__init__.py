@@ -1,0 +1,12 @@
+"""Device kernels: the batched admission solver.
+
+The reference's per-cycle admission loop (pkg/scheduler) is rebuilt here as
+JAX array programs over packed (Workload × ClusterQueue × FlavorResource)
+tensors: hierarchical quota as D-step parent-pointer recurrences, flavor
+assignment as masked argmax over the flavor axis, and the sequential admit
+loop as a lax.scan with the usage tensor as carry.  Semantics bit-match the
+scalar oracle in kueue_tpu.scheduler (verified in tests/test_solver_parity).
+"""
+
+from .packing import PackedCycle, pack_cycle  # noqa: F401
+from .solver import CycleSolver  # noqa: F401

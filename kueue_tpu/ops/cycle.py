@@ -1,0 +1,133 @@
+"""The batched admission cycle as one jitted program.
+
+Phase 1 (vectorized nominate): classify every head against every flavor
+slot at once — Fit / Preempt-capable / NoFit — mirroring
+findFlavorForPodSetResource (flavorassigner.go:499) under the default
+FlavorFungibility policy.
+
+Phase 2 (lax.scan admit loop): entries ordered by (borrows, priority desc,
+timestamp) as in entryOrdering.Less (scheduler.go:567); the usage tensor
+[N, F] is the scan carry so later entries see earlier admissions — the
+within-cycle sequential semantics of the reference admit loop.
+
+Preemption-capable entries are flagged; when any exist the host falls back
+to the scalar path for the whole cycle (bit-matching; device-side
+preemption search lands in a later round).
+"""
+
+from __future__ import annotations
+
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+
+from .quota_kernel import available_all, add_usage_chain
+
+BIG = 2**31 // 64
+
+
+@partial(jax.jit, static_argnames=("depth", "run_scan"))
+def solve_cycle(usage0, subtree, guaranteed, borrow_cap, has_blim, parent,
+                nominal_cq, slot_fr, slot_valid, cq_can_preempt_borrow,
+                wl_cq, wl_requests, wl_priority, wl_timestamp,
+                *, depth: int, run_scan: bool = True):
+    """Returns (admitted[W] bool, slot[W] int32, borrows[W] bool,
+    preempt_possible[W] bool, fit_slot0[W] int32, borrows0[W] bool).
+
+    With ``run_scan=False`` only the vectorized phase-1 classification runs
+    (the caller consumes fit_slot0/borrows0 and drives the sequential admit
+    loop host-side); the first three outputs are then zeros."""
+    C = slot_fr.shape[0]
+    W = wl_cq.shape[0]
+    S = slot_fr.shape[1]
+
+    avail0 = available_all(usage0, subtree, guaranteed, borrow_cap, has_blim,
+                           parent, depth)
+    potential0 = available_all(jnp.zeros_like(usage0), subtree, guaranteed,
+                               borrow_cap, has_blim, parent, depth)
+
+    def classify(avail, wl_cq_i, req):
+        """Per-workload slot classification given an avail tensor.
+
+        Returns (fit_slot int32 or -1, borrows bool, preempt_possible bool).
+        """
+        cq = jnp.maximum(wl_cq_i, 0)
+        frs = slot_fr[cq]                       # [S, R]
+        frs_safe = jnp.maximum(frs, 0)
+        covered = frs >= 0                      # [S, R]
+        needed = req[None, :] > 0               # [1, R] broadcast
+        # resource requested but not covered by this slot → slot invalid
+        missing = jnp.any(needed & ~covered, axis=1)        # [S]
+        av = avail[cq][frs_safe]                # [S, R] gather over F
+        pot = potential0[cq][frs_safe]
+        nom = nominal_cq[cq][frs_safe]
+        use = usage0[cq][frs_safe]              # CQ-local usage (for borrow calc)
+        sq = subtree[cq][frs_safe]
+
+        relevant = covered & needed
+        fits_r = jnp.where(relevant, req[None, :] <= av, True)
+        fit = jnp.all(fits_r, axis=1) & ~missing & slot_valid[cq]   # [S]
+        nofit_r = jnp.where(relevant, req[None, :] > pot, False)
+        nofit = (jnp.any(nofit_r, axis=1) | missing) | ~slot_valid[cq]
+        # preempt-capable: not fit, not nofit, and either within nominal
+        # quota or allowed to preempt while borrowing
+        # (flavorassigner.go:692 fitsResourceQuota)
+        within_nominal = jnp.all(
+            jnp.where(relevant, req[None, :] <= nom, True), axis=1)
+        preempt = ~fit & ~nofit & (within_nominal | cq_can_preempt_borrow[cq])
+        # borrowing: usage + req would exceed the CQ's own subtree quota
+        borrow_r = jnp.where(relevant, use + req[None, :] > sq, False)
+        borrows_s = jnp.any(borrow_r, axis=1)   # [S]
+
+        # default fungibility: first Fit slot wins (whenCanBorrow=Borrow)
+        fit_idx = jnp.argmax(fit)
+        has_fit = jnp.any(fit)
+        fit_slot = jnp.where(has_fit, fit_idx, -1)
+        borrows = jnp.where(has_fit, borrows_s[fit_idx], False)
+        preempt_possible = ~has_fit & jnp.any(preempt)
+        valid = wl_cq_i >= 0
+        return (jnp.where(valid, fit_slot, -1),
+                borrows & valid,
+                preempt_possible & valid)
+
+    fit_slot0, borrows0, preempt0 = jax.vmap(
+        lambda c, r: classify(avail0, c, r))(wl_cq, wl_requests)
+
+    if not run_scan:
+        zeros_b = jnp.zeros(W, dtype=bool)
+        zeros_i = jnp.full(W, -1, dtype=jnp.int32)
+        return zeros_b, zeros_i, zeros_b, preempt0, fit_slot0, borrows0
+
+    # --- ordering: borrows asc, priority desc, timestamp asc, index asc ---
+    order = jnp.lexsort((jnp.arange(W), wl_timestamp, -wl_priority,
+                         borrows0.astype(jnp.int32)))
+
+    # --- sequential admit scan ---
+    def step(usage, wi):
+        wl_cq_i = wl_cq[wi]
+        req = wl_requests[wi]
+        avail = available_all(usage, subtree, guaranteed, borrow_cap,
+                              has_blim, parent, depth)
+        fit_slot, borrows, _ = classify(avail, wl_cq_i, req)
+        admit = fit_slot >= 0
+        # scatter request into F space for the chosen slot
+        cq = jnp.maximum(wl_cq_i, 0)
+        frs = slot_fr[cq][jnp.maximum(fit_slot, 0)]      # [R]
+        delta_f = jnp.zeros(usage.shape[1], dtype=usage.dtype)
+        delta_f = delta_f.at[jnp.maximum(frs, 0)].add(
+            jnp.where((frs >= 0) & admit, req, 0))
+        new_usage = add_usage_chain(usage, cq, delta_f, guaranteed, parent,
+                                    depth)
+        usage = jnp.where(admit, new_usage, usage)
+        return usage, (wi, admit, fit_slot, borrows)
+
+    _, (order_out, admit_o, slot_o, borrows_o) = jax.lax.scan(
+        step, usage0, order)
+
+    # scatter back to original W order
+    admitted = jnp.zeros(W, dtype=bool).at[order_out].set(admit_o)
+    slots = jnp.full(W, -1, dtype=jnp.int32).at[order_out].set(slot_o)
+    borrows = jnp.zeros(W, dtype=bool).at[order_out].set(borrows_o)
+
+    return admitted, slots, borrows, preempt0, fit_slot0, borrows0

@@ -1,0 +1,275 @@
+"""Snapshot → packed device arrays.
+
+The deterministic codec from a cache Snapshot + cycle heads into static-
+shaped integer tensors (SURVEY §7 stage 1).  Axes:
+
+- N: quota nodes = ClusterQueues then Cohorts (parent-pointer forest)
+- F: distinct (flavor, resource) pairs appearing in any quota
+- W: cycle heads, padded to a bucket size (power of two) to bound
+  recompilation
+- S: flavor slots per resource group (max flavor-list length)
+- R: distinct resource names
+
+Quantities are canonical integers scaled per-resource so that everything
+fits int32 (TPU-native); the packer asserts exact divisibility and falls
+back to ceil-scaling requests (conservative) otherwise.  int64 milli-quanta
+on TPU is hard part (e) in SURVEY §7.
+"""
+
+from __future__ import annotations
+
+import math
+from dataclasses import dataclass, field
+from typing import Optional
+
+import numpy as np
+
+from ..cache.snapshot import Snapshot
+from ..cache.state import CohortState, CQState
+from ..resources import FlavorResource
+from ..workload import Info
+
+INT_INF = np.int64(2**62)  # "no limit" sentinel before scaling
+I32_MAX = 2**31 - 1
+
+
+@dataclass
+class PackedCycle:
+    # --- static cluster structure ---
+    cq_names: list[str]
+    node_count: int                      # N = len(cq_names) + cohorts
+    parent: np.ndarray                   # [N] int32, -1 for roots
+    depth: int                           # max tree depth (levels of parent hops)
+    fr_index: dict[FlavorResource, int]  # (flavor, resource) -> F
+    resource_names: list[str]            # R axis
+    resource_scale: np.ndarray           # [R] int64 divisor per resource
+
+    subtree_quota: np.ndarray            # [N, F] int32 (scaled)
+    guaranteed: np.ndarray               # [N, F] int32
+    borrow_cap: np.ndarray               # [N, F] int32: stored_in_parent + blimit (clipped)
+    has_borrow_limit: np.ndarray         # [N, F] bool
+    usage0: np.ndarray                   # [N, F] int32: usage at snapshot time
+
+    # flavor machinery: per CQ, per resource, ordered flavor slots -> F index
+    slot_fr: np.ndarray                  # [C, S, R] int32 F-index or -1
+    slot_valid: np.ndarray               # [C, S] bool (flavor exists & allowed)
+    nominal_cq: np.ndarray               # [C, F] int32 (for preempt classification)
+    cq_can_preempt_borrow: np.ndarray    # [C] bool: canPreemptWhileBorrowing
+
+    # --- per-cycle workloads ---
+    wl_count: int                        # true number of heads (<= W)
+    wl_cq: np.ndarray                    # [W] int32 CQ index (-1 pad)
+    wl_requests: np.ndarray              # [W, R] int32 total requests (scaled)
+    wl_priority: np.ndarray              # [W] int32
+    wl_timestamp: np.ndarray             # [W] float64 queue-order timestamp
+    wl_keys: list[str] = field(default_factory=list)
+
+
+def _bucket(n: int, minimum: int = 8) -> int:
+    return max(minimum, 1 << math.ceil(math.log2(max(1, n))))
+
+
+def _iter_nodes(snapshot: Snapshot):
+    """CQs first, then cohorts (stable order)."""
+    cq_names = sorted(snapshot.cluster_queues)
+    cohorts: list[CohortState] = []
+    seen = set()
+
+    def walk(c: CohortState):
+        if id(c) in seen:
+            return
+        seen.add(id(c))
+        cohorts.append(c)
+        for ch in c.child_cohorts:
+            walk(ch)
+
+    for root in snapshot.roots:
+        walk(root)
+    # cohorts reachable only via CQ parents (defensive)
+    for name in cq_names:
+        c = snapshot.cluster_queues[name].parent
+        while c is not None and id(c) not in seen:
+            walk(c)
+            c = c.parent
+    return cq_names, cohorts
+
+
+def snapshot_fair_sharing(snapshot: Snapshot) -> bool:
+    return bool(getattr(snapshot, "fair_sharing_enabled", False))
+
+
+def pack_cycle(snapshot: Snapshot, heads: list[Info],
+               ordering=None) -> PackedCycle:
+    cq_names, cohorts = _iter_nodes(snapshot)
+    cq_idx = {n: i for i, n in enumerate(cq_names)}
+    cohort_idx = {id(c): len(cq_names) + i for i, c in enumerate(cohorts)}
+    C = len(cq_names)
+    N = C + len(cohorts)
+
+    # F axis
+    frs: set[FlavorResource] = set()
+    for name in cq_names:
+        cq = snapshot.cluster_queues[name]
+        frs.update(cq.resource_node.quotas)
+        frs.update(cq.resource_node.usage)
+    for c in cohorts:
+        frs.update(c.resource_node.quotas)
+        frs.update(c.resource_node.usage)
+    fr_list = sorted(frs)
+    fr_index = {fr: i for i, fr in enumerate(fr_list)}
+    F = max(1, len(fr_list))
+
+    # CQs whose resource groups cover the implicit "pods" resource get
+    # requests[pods] = pod count injected (flavorassigner.go:226).
+    cq_covers_pods = {
+        name for name in cq_names
+        if any("pods" in rg.covered_resources
+               for rg in snapshot.cluster_queues[name].spec.resource_groups)}
+
+    resource_names = sorted({fr.resource for fr in fr_list}
+                            | {r for h in heads for psr in h.total_requests
+                               for r in psr.requests}
+                            | ({"pods"} if cq_covers_pods else set()))
+    r_index = {r: i for i, r in enumerate(resource_names)}
+    R = max(1, len(resource_names))
+
+    # resource scaling to int32
+    max_per_resource = np.zeros(R, dtype=np.int64)
+    all_vals: dict[int, list[int]] = {i: [] for i in range(R)}
+
+    def note(r: str, v: int):
+        if r in r_index and v < INT_INF:
+            i = r_index[r]
+            max_per_resource[i] = max(max_per_resource[i], abs(v))
+            all_vals[i].append(abs(v))
+
+    nodes: list = [snapshot.cluster_queues[n] for n in cq_names] + cohorts
+    for node in nodes:
+        for fr, q in node.resource_node.quotas.items():
+            note(fr.resource, q.nominal)
+            if q.borrowing_limit is not None:
+                note(fr.resource, q.borrowing_limit)
+        for fr, v in node.resource_node.subtree_quota.items():
+            note(fr.resource, v)
+        for fr, v in node.resource_node.usage.items():
+            note(fr.resource, v)
+    for h in heads:
+        for psr in h.total_requests:
+            for r, v in psr.requests.items():
+                note(r, v)
+
+    scale = np.ones(R, dtype=np.int64)
+    for i in range(R):
+        # headroom ×64: sums across the tree must also stay in int32
+        while max_per_resource[i] // scale[i] > I32_MAX // 64:
+            scale[i] *= 2
+
+    def scaled(r: str, v) -> int:
+        if v >= INT_INF:
+            return int(I32_MAX // 64)
+        s = int(scale[r_index[r]])
+        return int(v) // s if v >= 0 else -((-int(v)) // s)
+
+    def scaled_ceil(r: str, v) -> int:
+        if v >= INT_INF:
+            return int(I32_MAX // 64)
+        s = int(scale[r_index[r]])
+        return -((-int(v)) // s)
+
+    # node tensors
+    subtree = np.zeros((N, F), dtype=np.int32)
+    guaranteed = np.zeros((N, F), dtype=np.int32)
+    borrow_cap = np.full((N, F), int(I32_MAX // 64), dtype=np.int32)
+    has_blim = np.zeros((N, F), dtype=bool)
+    usage0 = np.zeros((N, F), dtype=np.int32)
+    parent = np.full(N, -1, dtype=np.int32)
+    nominal_cq = np.zeros((C, F), dtype=np.int32)
+
+    for ni, node in enumerate(nodes):
+        if ni < C:
+            p = node.parent
+            parent[ni] = cohort_idx[id(p)] if p is not None else -1
+        else:
+            p = node.parent
+            parent[ni] = cohort_idx[id(p)] if p is not None else -1
+        rn = node.resource_node
+        for fr, fi in fr_index.items():
+            sq = rn.subtree_quota.get(fr, 0)
+            subtree[ni, fi] = scaled(fr.resource, sq)
+            guaranteed[ni, fi] = scaled(fr.resource, rn.guaranteed_quota(fr))
+            usage0[ni, fi] = scaled_ceil(fr.resource, rn.usage.get(fr, 0))
+            q = rn.quotas.get(fr)
+            if ni < C and q is not None:
+                nominal_cq[ni, fi] = scaled(fr.resource, q.nominal)
+            if q is not None and q.borrowing_limit is not None:
+                has_blim[ni, fi] = True
+                stored = sq - rn.guaranteed_quota(fr)
+                borrow_cap[ni, fi] = scaled(fr.resource,
+                                            stored + q.borrowing_limit)
+
+    # depth
+    depth = 1
+    for ni in range(N):
+        d, p = 1, parent[ni]
+        while p >= 0:
+            d += 1
+            p = parent[p]
+        depth = max(depth, d)
+
+    # flavor slots per CQ
+    S = 1
+    for name in cq_names:
+        for rg in snapshot.cluster_queues[name].spec.resource_groups:
+            S = max(S, len(rg.flavors))
+    slot_fr = np.full((C, S, R), -1, dtype=np.int32)
+    slot_valid = np.zeros((C, S), dtype=bool)
+    cq_can_preempt_borrow = np.zeros(C, dtype=bool)
+    from ..api.types import BorrowWithinCohortPolicy, ReclaimWithinCohort
+    for ci, name in enumerate(cq_names):
+        p = snapshot.cluster_queues[name].spec.preemption
+        cq_can_preempt_borrow[ci] = (
+            p.borrow_within_cohort.policy != BorrowWithinCohortPolicy.NEVER
+            or (snapshot_fair_sharing(snapshot)
+                and p.reclaim_within_cohort != ReclaimWithinCohort.NEVER))
+    for ci, name in enumerate(cq_names):
+        cq = snapshot.cluster_queues[name]
+        for rg in cq.spec.resource_groups:
+            for si, fq in enumerate(rg.flavors):
+                exists = fq.name in snapshot.resource_flavors
+                slot_valid[ci, si] = slot_valid[ci, si] or exists
+                for rname in rg.covered_resources:
+                    if rname in r_index:
+                        fr = FlavorResource(fq.name, rname)
+                        if fr in fr_index and exists:
+                            slot_fr[ci, si, r_index[rname]] = fr_index[fr]
+
+    # workloads
+    W = _bucket(len(heads))
+    wl_cq = np.full(W, -1, dtype=np.int32)
+    wl_requests = np.zeros((W, R), dtype=np.int32)
+    wl_priority = np.zeros(W, dtype=np.int32)
+    wl_timestamp = np.zeros(W, dtype=np.float64)
+    wl_keys = []
+    for wi, h in enumerate(heads):
+        wl_keys.append(h.key)
+        wl_cq[wi] = cq_idx.get(h.cluster_queue, -1)
+        for psr in h.total_requests:
+            for r, v in psr.requests.items():
+                wl_requests[wi, r_index[r]] += scaled_ceil(r, v)
+            if h.cluster_queue in cq_covers_pods:
+                wl_requests[wi, r_index["pods"]] += psr.count
+        wl_priority[wi] = h.obj.priority
+        wl_timestamp[wi] = (ordering.queue_order_timestamp(h.obj)
+                            if ordering is not None else h.obj.creation_time)
+
+    return PackedCycle(
+        cq_names=cq_names, node_count=N, parent=parent, depth=depth,
+        fr_index=fr_index, resource_names=resource_names,
+        resource_scale=scale,
+        subtree_quota=subtree, guaranteed=guaranteed,
+        borrow_cap=borrow_cap, has_borrow_limit=has_blim, usage0=usage0,
+        slot_fr=slot_fr, slot_valid=slot_valid, nominal_cq=nominal_cq,
+        cq_can_preempt_borrow=cq_can_preempt_borrow,
+        wl_count=len(heads), wl_cq=wl_cq, wl_requests=wl_requests,
+        wl_priority=wl_priority, wl_timestamp=wl_timestamp, wl_keys=wl_keys,
+    )

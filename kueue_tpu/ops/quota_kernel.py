@@ -1,0 +1,79 @@
+"""Hierarchical quota as array ops over the parent-pointer forest.
+
+The reference's recursive ``available``/``addUsage`` walks
+(pkg/cache/resource_node.go:89-144) become D-step vectorized recurrences
+over [N, F] tensors (D = forest depth, static).  XLA unrolls the D loop and
+fuses the gathers; no data-dependent control flow.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+BIG = 2**31 // 64  # "unlimited" sentinel, matches packer headroom
+
+
+def available_all(usage: jnp.ndarray, subtree: jnp.ndarray,
+                  guaranteed: jnp.ndarray, borrow_cap: jnp.ndarray,
+                  has_blim: jnp.ndarray, parent: jnp.ndarray,
+                  depth: int) -> jnp.ndarray:
+    """available() for every node at once: [N, F] → [N, F].
+
+    Top-down recurrence (resource_node.go:89): roots are exact immediately;
+    each iteration finalizes one more level below.
+    """
+    is_root = (parent < 0)[:, None]
+    parent_safe = jnp.maximum(parent, 0)
+
+    root_avail = subtree - usage
+    local = jnp.maximum(0, guaranteed - usage)
+    used_in_parent = jnp.maximum(0, usage - guaranteed)
+    blim_cap = borrow_cap - used_in_parent
+
+    avail = root_avail  # exact for roots; refined for deeper nodes below
+
+    def body(_, avail):
+        parent_avail = avail[parent_safe]
+        parent_avail = jnp.where(has_blim,
+                                 jnp.minimum(blim_cap, parent_avail),
+                                 parent_avail)
+        return jnp.where(is_root, root_avail, local + parent_avail)
+
+    return jax.lax.fori_loop(0, depth, body, avail)
+
+
+def potential_available_all(subtree: jnp.ndarray, guaranteed: jnp.ndarray,
+                            borrow_cap: jnp.ndarray, has_blim: jnp.ndarray,
+                            parent: jnp.ndarray, depth: int) -> jnp.ndarray:
+    """potentialAvailable() for every node (resource_node.go:108).
+
+    Usage-free, so ``usage=0``: local = guaranteed, blim cap =
+    subtree - guaranteed + blimit = borrow_cap.
+    """
+    zero = jnp.zeros_like(subtree)
+    return available_all(zero, subtree, guaranteed, borrow_cap, has_blim,
+                         parent, depth)
+
+
+def add_usage_chain(usage: jnp.ndarray, node: jnp.ndarray, delta: jnp.ndarray,
+                    guaranteed: jnp.ndarray, parent: jnp.ndarray,
+                    depth: int) -> jnp.ndarray:
+    """addUsage() bubbling up one ancestor chain (resource_node.go:123).
+
+    node: scalar int32 index; delta: [F] int32 (>=0).  Returns new usage.
+    """
+    def body(i, state):
+        usage, cur, carry = state
+        valid = cur >= 0
+        cur_safe = jnp.maximum(cur, 0)
+        local_avail = jnp.maximum(0, guaranteed[cur_safe] - usage[cur_safe])
+        add = jnp.where(valid, carry, 0)
+        usage = usage.at[cur_safe].add(add)
+        next_carry = jnp.maximum(0, carry - local_avail)
+        next_cur = jnp.where(valid, parent[cur_safe], -1)
+        return usage, next_cur, jnp.where(valid, next_carry, carry)
+
+    usage, _, _ = jax.lax.fori_loop(
+        0, depth, body, (usage, node.astype(jnp.int32), delta))
+    return usage

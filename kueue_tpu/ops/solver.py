@@ -1,0 +1,124 @@
+"""Host wrapper for the device cycle solver.
+
+Packs a (snapshot, heads) pair, invokes the jitted batched cycle
+(kueue_tpu.ops.cycle), and converts results back into Assignment objects
+compatible with the scalar scheduler path.  Falls back (returns None) when
+the cycle needs semantics not yet on device: preemption candidates, TAS
+requests, fair sharing, non-default fungibility, multi-resource-group CQs,
+or admission-check strategies — the host path then runs, keeping decisions
+bit-identical.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import numpy as np
+
+from ..api.types import FlavorFungibility, FlavorFungibilityPolicy
+from ..cache.snapshot import Snapshot
+from ..workload import Info, Ordering
+from ..scheduler.flavorassigner import (
+    Assignment,
+    FlavorAssignmentDecision,
+    Mode,
+    PodSetAssignmentResult,
+)
+from ..resources import FlavorResource, Requests
+from .packing import pack_cycle
+from .cycle import solve_cycle
+
+_DEFAULT_FF = FlavorFungibility()
+
+
+class CycleSolver:
+    """Batched device solver for pure-Fit cycles."""
+
+    def __init__(self, ordering: Ordering | None = None):
+        self.ordering = ordering or Ordering()
+        self.stats = {"device_cycles": 0, "host_fallbacks": 0}
+
+    # -- eligibility ---------------------------------------------------
+
+    def _supported(self, snapshot: Snapshot, heads: list[Info]) -> bool:
+        for h in heads:
+            if len(h.obj.pod_sets) > 1:
+                # the host can split flavors across pod sets; the device
+                # currently solves the summed request against one flavor
+                return False
+            for ps in h.obj.pod_sets:
+                if ps.topology_request is not None:
+                    return False
+                if ps.min_count is not None:
+                    return False
+                if ps.node_selector or ps.required_node_affinity or ps.tolerations:
+                    return False  # affinity/taint matching stays on host
+        for name, cq in snapshot.cluster_queues.items():
+            if len(cq.spec.resource_groups) > 1:
+                return False
+            ff = cq.spec.flavor_fungibility
+            if (ff.when_can_borrow != _DEFAULT_FF.when_can_borrow
+                    or ff.when_can_preempt != _DEFAULT_FF.when_can_preempt):
+                return False
+            for rg in cq.spec.resource_groups:
+                for fq in rg.flavors:
+                    flavor = snapshot.resource_flavors.get(fq.name)
+                    if flavor is None:
+                        return False
+                    if flavor.node_taints or flavor.topology_name:
+                        return False
+        return True
+
+    # -- solve ---------------------------------------------------------
+
+    def try_solve(self, snapshot: Snapshot, heads: list[Info]
+                  ) -> Optional[dict[str, Assignment]]:
+        """Returns {workload_key: Fit Assignment} for admitted heads, or
+        None when the host path must run."""
+        if not heads or not self._supported(snapshot, heads):
+            self.stats["host_fallbacks"] += 1
+            return None
+        packed = pack_cycle(snapshot, heads, self.ordering)
+        (_admitted, _slots, _borrows, preempt_possible,
+         fit_slot0, borrows0) = solve_cycle(
+            packed.usage0, packed.subtree_quota, packed.guaranteed,
+            packed.borrow_cap, packed.has_borrow_limit, packed.parent,
+            packed.nominal_cq, packed.slot_fr, packed.slot_valid,
+            packed.cq_can_preempt_borrow,
+            packed.wl_cq, packed.wl_requests, packed.wl_priority,
+            packed.wl_timestamp, depth=packed.depth, run_scan=False)
+        fit_slot0 = np.asarray(fit_slot0)
+        borrows0 = np.asarray(borrows0)
+        preempt_possible = np.asarray(preempt_possible)
+        n = packed.wl_count
+        if preempt_possible[:n].any():
+            # preemption semantics stay on host for now
+            self.stats["host_fallbacks"] += 1
+            return None
+        self.stats["device_cycles"] += 1
+
+        out: dict[str, Assignment] = {}
+        for wi in range(n):
+            if fit_slot0[wi] < 0:
+                continue
+            h = heads[wi]
+            cq = snapshot.cq(h.cluster_queue)
+            rg = cq.spec.resource_groups[0]
+            flavor_name = rg.flavors[int(fit_slot0[wi])].name
+            assignment = Assignment()
+            assignment.borrowing = bool(borrows0[wi])
+            assignment.last_state.cluster_queue_generation = cq.allocatable_generation
+            for psr in h.total_requests:
+                ps_res = PodSetAssignmentResult(
+                    name=psr.name, requests=Requests(psr.requests),
+                    count=psr.count)
+                for res in psr.requests:
+                    ps_res.flavors[res] = FlavorAssignmentDecision(
+                        name=flavor_name, mode=Mode.FIT,
+                        borrow=bool(borrows0[wi]))
+                    fr = FlavorResource(flavor_name, res)
+                    assignment.usage[fr] = (assignment.usage.get(fr, 0)
+                                            + psr.requests[res])
+                assignment.pod_sets.append(ps_res)
+            out[h.key] = assignment
+        return out

@@ -125,6 +125,7 @@ class Scheduler:
             return stats
         snapshot = self.cache.snapshot()
         entries = self.nominate(heads, snapshot)
+        self._maybe_solve_on_device(entries, snapshot)
         iterator = self._make_iterator(entries, snapshot)
 
         preempted_workloads: dict[str, Info] = {}
@@ -209,13 +210,43 @@ class Scheduler:
                 e.requeue_reason = RequeueReason.NAMESPACE_MISMATCH
             elif not self._validate_resources(info):
                 e.inadmissible_msg = "resource validation failed"
+            elif self.solver is not None and not self.fair_sharing:
+                e.status = EntryStatus.NOT_NOMINATED
+                e.inadmissible_msg = "__deferred__"  # batched assignment below
             else:
-                e.assignment, e.preemption_targets = self._get_assignments(
-                    info, snapshot)
-                e.inadmissible_msg = e.assignment.message()
-                info.last_assignment = e.assignment.last_state
+                self._assign_entry(e, snapshot)
             entries.append(e)
         return entries
+
+    def _assign_entry(self, e: Entry, snapshot: Snapshot) -> None:
+        e.assignment, e.preemption_targets = self._get_assignments(
+            e.info, snapshot)
+        e.inadmissible_msg = e.assignment.message()
+        e.info.last_assignment = e.assignment.last_state
+
+    def _maybe_solve_on_device(self, entries: list[Entry],
+                               snapshot: Snapshot) -> None:
+        """Batched nominate: one device solve replaces per-head flavor
+        assignment when the cycle needs no preemption/TAS semantics."""
+        deferred = [e for e in entries if e.inadmissible_msg == "__deferred__"]
+        if not deferred:
+            return
+        solved = None
+        if self.solver is not None:
+            solved = self.solver.try_solve(snapshot, [e.info for e in deferred])
+        if solved is None:
+            for e in deferred:
+                self._assign_entry(e, snapshot)
+            return
+        for e in deferred:
+            assignment = solved.get(e.info.key)
+            if assignment is not None:
+                e.assignment = assignment
+                e.inadmissible_msg = ""
+            else:
+                e.assignment = Assignment()
+                e.inadmissible_msg = "insufficient quota (batched solver)"
+            e.info.last_assignment = e.assignment.last_state
 
     @staticmethod
     def _has_retry_or_rejected_checks(wl: Workload) -> bool:

