@@ -1,0 +1,156 @@
+"""End-to-end scheduler benchmark: drain the reference perf scenario.
+
+Mirrors test/performance/scheduler (reference default_generator_config.yaml:
+5 cohorts × 6 CQs, nominal 20 units, borrowingLimit 100; per CQ 350 small
+(1 unit, prio 50) + 100 medium (5 units, prio 100) + 50 large (20 units,
+prio 200) = 15,000 workloads), but scheduler-limited: all workloads are
+pending at t0 and fake execution finishes an admitted workload a fixed
+number of cycles after admission (the reference runner flips conditions
+after runtimeMs — runner/controller/controller.go:113).
+
+Baseline: the Go scheduler drains the same 15k workloads in ~351 s wall
+(default_rangespec.yaml:8-9) ≈ 42.7 admissions/s — that run is partly
+arrival-limited (workloads are created over ~35-60 s per class), so treat
+vs_baseline as a throughput ratio on the same scenario, not a strict
+apples-to-apples wall-clock.
+
+Prints ONE JSON line on stdout; diagnostics go to stderr.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+import time
+
+from kueue_tpu.api.types import (
+    ClusterQueue,
+    FlavorQuotas,
+    LocalQueue,
+    PodSet,
+    PreemptionPolicy,
+    ReclaimWithinCohort,
+    ResourceFlavor,
+    ResourceGroup,
+    ResourceQuota,
+    WithinClusterQueue,
+    Workload,
+)
+from kueue_tpu.controller.driver import Driver
+
+BASELINE_WALL_S = 351.116          # default_rangespec.yaml avg
+BASELINE_ADMISSIONS_PER_S = 15000 / BASELINE_WALL_S
+
+N_COHORTS = 5
+CQS_PER_COHORT = 6
+UNIT = 1000                        # 1 "unit" = 1 CPU = 1000 milli
+CLASSES = [                        # (count/CQ, units, priority)
+    ("small", 350, 1, 50),
+    ("medium", 100, 5, 100),
+    ("large", 50, 20, 200),
+]
+RUNTIME_CYCLES = 2                 # fake execution length per workload
+
+
+class VirtualClock:
+    def __init__(self, t=1000.0):
+        self.t = t
+
+    def __call__(self):
+        return self.t
+
+
+def build(scale: float):
+    clock = VirtualClock()
+    d = Driver(clock=clock,
+               use_device_solver=os.environ.get("BENCH_DEVICE") == "1")
+    d.apply_resource_flavor(ResourceFlavor(name="default"))
+    total = 0
+    for c in range(N_COHORTS):
+        for q in range(CQS_PER_COHORT):
+            name = f"cq-{c}-{q}"
+            d.apply_cluster_queue(ClusterQueue(
+                name=name, cohort=f"cohort-{c}",
+                preemption=PreemptionPolicy(
+                    reclaim_within_cohort=ReclaimWithinCohort.ANY,
+                    within_cluster_queue=WithinClusterQueue.LOWER_PRIORITY),
+                resource_groups=[ResourceGroup(
+                    covered_resources=["cpu"],
+                    flavors=[FlavorQuotas(name="default", resources={
+                        "cpu": ResourceQuota(nominal=20 * UNIT,
+                                             borrowing_limit=100 * UNIT)})])]))
+            d.apply_local_queue(LocalQueue(name=f"lq-{c}-{q}",
+                                           cluster_queue=name))
+            i = 0
+            for cls, count, units, prio in CLASSES:
+                for k in range(max(1, int(count * scale))):
+                    i += 1
+                    total += 1
+                    d.create_workload(Workload(
+                        name=f"{cls}-{c}-{q}-{k}", queue_name=f"lq-{c}-{q}",
+                        priority=prio, creation_time=float(total),
+                        pod_sets=[PodSet(name="main", count=1,
+                                         requests={"cpu": units * UNIT})]))
+    return d, clock, total
+
+
+def run(d: Driver, clock: VirtualClock, total: int):
+    finished = 0
+    admitted_seen: set[str] = set()
+    running: list[tuple[int, str]] = []   # (finish_at_cycle, key)
+    cycle = 0
+    cycle_times = []
+    t0 = time.perf_counter()
+    while finished < total:
+        cycle += 1
+        clock.t += 1.0
+        c0 = time.perf_counter()
+        d.schedule_once()
+        cycle_times.append(time.perf_counter() - c0)
+        now_admitted = d.admitted_keys()
+        for key in now_admitted - admitted_seen:
+            running.append((cycle + RUNTIME_CYCLES, key))
+        admitted_seen |= now_admitted
+        still = []
+        for finish_at, key in running:
+            if finish_at <= cycle and key in now_admitted:
+                d.finish_workload(key)
+                finished += 1
+            elif key in now_admitted:
+                still.append((finish_at, key))
+            # evicted/preempted workloads re-enter via admitted_seen reset
+        running = still
+        admitted_seen &= d.admitted_keys()
+        if cycle > total * 4 + 1000:
+            print(f"bench stalled: cycle={cycle} finished={finished}/{total}",
+                  file=sys.stderr)
+            break
+    wall = time.perf_counter() - t0
+    return wall, cycle, cycle_times, finished
+
+
+def main():
+    scale = float(os.environ.get("BENCH_SCALE", "1.0"))
+    d, clock, total = build(scale)
+    print(f"scenario: {N_COHORTS * CQS_PER_COHORT} CQs, {total} workloads, "
+          f"scale={scale}", file=sys.stderr)
+    wall, cycles, cycle_times, finished = run(d, clock, total)
+    cycle_times.sort()
+    p50 = cycle_times[len(cycle_times) // 2] if cycle_times else 0.0
+    p99 = cycle_times[int(len(cycle_times) * 0.99)] if cycle_times else 0.0
+    aps = finished / wall if wall > 0 else 0.0
+    print(f"drained {finished}/{total} in {wall:.2f}s over {cycles} cycles; "
+          f"cycle p50={p50 * 1e3:.2f}ms p99={p99 * 1e3:.2f}ms; "
+          f"device cycles={getattr(d.scheduler.solver, 'stats', {})}",
+          file=sys.stderr)
+    print(json.dumps({
+        "metric": "admissions_per_sec_drain_15k_workloads_30cq",
+        "value": round(aps, 2),
+        "unit": "admissions/s",
+        "vs_baseline": round(aps / BASELINE_ADMISSIONS_PER_S, 3),
+    }))
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,17 @@
+"""Multi-chip parallelism: device meshes and sharded cycle solving.
+
+The admission cycle is SPMD over two axes (SURVEY §2.5, §7):
+
+- ``wl``  — the pending-workload batch axis (data-parallel analog): the
+  phase-1 nominate/classify pass is embarrassingly parallel over heads.
+- ``cq``  — the quota plane (ClusterQueue/cohort node axis, model-parallel
+  analog): quota/usage tensors are sharded over nodes; XLA inserts the
+  gather collectives where a workload reads a remote CQ's availability.
+
+There is no NCCL/MPI here by design: collectives are XLA's, riding ICI
+(reference equivalent: the API-server watch fabric, SURVEY §5.8).
+"""
+
+from .sharded import cycle_args, make_mesh, sharded_cycle_fn
+
+__all__ = ["cycle_args", "make_mesh", "sharded_cycle_fn"]

@@ -1,0 +1,83 @@
+"""Mesh construction and the sharded admission-cycle step.
+
+``sharded_cycle_fn`` jits :func:`kueue_tpu.ops.cycle.solve_cycle` over a
+2-D ``(wl, cq)`` mesh with explicit NamedShardings:
+
+- workload tensors (``wl_*``) are sharded over ``wl`` — each chip
+  classifies its slice of the pending batch against all flavors;
+- quota-node tensors (``usage0``/``subtree``/…, first axis N) and the
+  per-CQ flavor machinery (``nominal_cq``/``slot_fr``/…, first axis C) are
+  sharded over ``cq`` — the quota plane is distributed and XLA all-gathers
+  the slices a workload's CQ lookup needs.
+
+The sequential admit scan (phase 2) carries the usage tensor; GSPMD keeps
+it sharded over ``cq`` and reduces the per-step delta with ICI
+collectives.  This is the multi-chip story for the north-star scale
+(100k workloads × 1k CQs — BASELINE.json): wl for throughput, cq for a
+quota plane too big for one chip's HBM.
+"""
+
+from __future__ import annotations
+
+import jax
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from ..ops.cycle import solve_cycle
+from ..ops.packing import PackedCycle
+
+
+def make_mesh(n_devices: int | None = None, devices=None) -> Mesh:
+    """A 2-D (wl, cq) mesh over the first ``n_devices`` devices.
+
+    ``n`` is factored as evenly as possible (8 → 4×2, 4 → 2×2, prime
+    p → p×1) so both axes exist even on small meshes.
+    """
+    if devices is None:
+        devices = jax.devices()
+    if n_devices is not None:
+        devices = devices[:n_devices]
+    n = len(devices)
+    wl = n
+    for cand in range(int(np.sqrt(n)), 0, -1):
+        if n % cand == 0:
+            wl = n // cand
+            break
+    cq = n // wl
+    dev_array = np.asarray(devices).reshape(wl, cq)
+    return Mesh(dev_array, axis_names=("wl", "cq"))
+
+
+def cycle_args(packed: PackedCycle) -> tuple:
+    """Positional args for solve_cycle, in signature order."""
+    return (packed.usage0, packed.subtree_quota, packed.guaranteed,
+            packed.borrow_cap, packed.has_borrow_limit, packed.parent,
+            packed.nominal_cq, packed.slot_fr, packed.slot_valid,
+            packed.cq_can_preempt_borrow, packed.wl_cq, packed.wl_requests,
+            packed.wl_priority, packed.wl_timestamp)
+
+
+def cycle_shardings(mesh: Mesh):
+    """NamedShardings matching the cycle_args order."""
+    node = NamedSharding(mesh, P("cq"))          # [N] / [N, F]
+    cqax = NamedSharding(mesh, P("cq"))          # [C, ...]
+    wl = NamedSharding(mesh, P("wl"))            # [W] / [W, R]
+    rep = NamedSharding(mesh, P())
+    return (node, node, node, node, node, rep,   # usage0..has_blim, parent
+            cqax, cqax, cqax, cqax,              # nominal_cq..can_preempt
+            wl, wl, wl, wl)                      # wl_cq..wl_timestamp
+
+
+def sharded_cycle_fn(mesh: Mesh, depth: int, run_scan: bool = True):
+    """A jitted solve_cycle bound to ``mesh`` with the standard shardings.
+
+    Inputs whose sharded axis is not divisible by the mesh axis are left
+    to GSPMD's uneven-sharding support; callers should still prefer
+    bucket-padded shapes (the packer pads W) to keep layouts tight.
+    """
+    in_shardings = cycle_shardings(mesh)
+
+    def step(*args):
+        return solve_cycle(*args, depth=depth, run_scan=run_scan)
+
+    return jax.jit(step, in_shardings=in_shardings)

@@ -1,8 +1,14 @@
 import os
 
 # Multi-chip sharding is validated on a virtual 8-device CPU mesh; the real
-# TPU path is exercised by bench.py / the driver.
+# TPU path is exercised by bench.py / the driver.  The axon TPU plugin in
+# this image ignores JAX_PLATFORMS from the environment, so the config
+# update below is the authoritative switch.
 os.environ.setdefault("JAX_PLATFORMS", "cpu")
 flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in flags:
     os.environ["XLA_FLAGS"] = (flags + " --xla_force_host_platform_device_count=8").strip()
+
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
