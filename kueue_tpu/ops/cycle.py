@@ -24,8 +24,6 @@ import jax.numpy as jnp
 
 from .quota_kernel import available_all, add_usage_chain
 
-BIG = 2**31 // 64
-
 
 @partial(jax.jit, static_argnames=("depth", "run_scan"))
 def solve_cycle(usage0, subtree, guaranteed, borrow_cap, has_blim, parent,
@@ -47,8 +45,8 @@ def solve_cycle(usage0, subtree, guaranteed, borrow_cap, has_blim, parent,
     potential0 = available_all(jnp.zeros_like(usage0), subtree, guaranteed,
                                borrow_cap, has_blim, parent, depth)
 
-    def classify(avail, wl_cq_i, req):
-        """Per-workload slot classification given an avail tensor.
+    def classify(avail, usage, wl_cq_i, req):
+        """Per-workload slot classification given avail/usage tensors.
 
         Returns (fit_slot int32 or -1, borrows bool, preempt_possible bool).
         """
@@ -62,23 +60,31 @@ def solve_cycle(usage0, subtree, guaranteed, borrow_cap, has_blim, parent,
         av = avail[cq][frs_safe]                # [S, R] gather over F
         pot = potential0[cq][frs_safe]
         nom = nominal_cq[cq][frs_safe]
-        use = usage0[cq][frs_safe]              # CQ-local usage (for borrow calc)
+        use = usage[cq][frs_safe]               # CQ-local usage (for borrow calc)
         sq = subtree[cq][frs_safe]
 
+        # Per-resource mode lattice (flavorassigner.go:692 fitsResourceQuota,
+        # evaluated per resource; the slot's representative mode is the min):
+        #   fit:     req <= available
+        #   nofit:   req > potentialAvailable, or neither fit nor
+        #            preempt-capable
+        #   preempt: otherwise, if req <= nominal or the CQ may preempt
+        #            while borrowing
         relevant = covered & needed
-        fits_r = jnp.where(relevant, req[None, :] <= av, True)
-        fit = jnp.all(fits_r, axis=1) & ~missing & slot_valid[cq]   # [S]
-        nofit_r = jnp.where(relevant, req[None, :] > pot, False)
-        nofit = (jnp.any(nofit_r, axis=1) | missing) | ~slot_valid[cq]
-        # preempt-capable: not fit, not nofit, and either within nominal
-        # quota or allowed to preempt while borrowing
-        # (flavorassigner.go:692 fitsResourceQuota)
-        within_nominal = jnp.all(
-            jnp.where(relevant, req[None, :] <= nom, True), axis=1)
-        preempt = ~fit & ~nofit & (within_nominal | cq_can_preempt_borrow[cq])
-        # borrowing: usage + req would exceed the CQ's own subtree quota
+        fit_r = req[None, :] <= av              # [S, R]
+        nofit_r = req[None, :] > pot
+        preempt_capable_r = (req[None, :] <= nom) | cq_can_preempt_borrow[cq]
+        res_nofit = relevant & (nofit_r | (~fit_r & ~preempt_capable_r))
+
+        fit = (jnp.all(jnp.where(relevant, fit_r, True), axis=1)
+               & ~missing & slot_valid[cq])     # [S]
+        nofit = jnp.any(res_nofit, axis=1) | missing | ~slot_valid[cq]
+        preempt = ~fit & ~nofit
+        # borrowing: usage + req would exceed the CQ's own subtree quota,
+        # and the CQ is in a cohort (clusterqueue_snapshot.go BorrowingWith)
+        has_parent = parent[cq] >= 0
         borrow_r = jnp.where(relevant, use + req[None, :] > sq, False)
-        borrows_s = jnp.any(borrow_r, axis=1)   # [S]
+        borrows_s = jnp.any(borrow_r, axis=1) & has_parent   # [S]
 
         # default fungibility: first Fit slot wins (whenCanBorrow=Borrow)
         fit_idx = jnp.argmax(fit)
@@ -92,7 +98,7 @@ def solve_cycle(usage0, subtree, guaranteed, borrow_cap, has_blim, parent,
                 preempt_possible & valid)
 
     fit_slot0, borrows0, preempt0 = jax.vmap(
-        lambda c, r: classify(avail0, c, r))(wl_cq, wl_requests)
+        lambda c, r: classify(avail0, usage0, c, r))(wl_cq, wl_requests)
 
     if not run_scan:
         zeros_b = jnp.zeros(W, dtype=bool)
@@ -109,7 +115,7 @@ def solve_cycle(usage0, subtree, guaranteed, borrow_cap, has_blim, parent,
         req = wl_requests[wi]
         avail = available_all(usage, subtree, guaranteed, borrow_cap,
                               has_blim, parent, depth)
-        fit_slot, borrows, _ = classify(avail, wl_cq_i, req)
+        fit_slot, borrows, _ = classify(avail, usage, wl_cq_i, req)
         admit = fit_slot >= 0
         # scatter request into F space for the chosen slot
         cq = jnp.maximum(wl_cq_i, 0)

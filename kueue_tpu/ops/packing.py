@@ -63,6 +63,7 @@ class PackedCycle:
     wl_priority: np.ndarray              # [W] int32
     wl_timestamp: np.ndarray             # [W] float64 queue-order timestamp
     wl_keys: list[str] = field(default_factory=list)
+    exact: bool = True                   # scaled comparisons are lossless
 
 
 def _bucket(n: int, minimum: int = 8) -> int:
@@ -135,13 +136,14 @@ def pack_cycle(snapshot: Snapshot, heads: list[Info],
 
     # resource scaling to int32
     max_per_resource = np.zeros(R, dtype=np.int64)
-    all_vals: dict[int, list[int]] = {i: [] for i in range(R)}
+    gcd_per_resource = np.zeros(R, dtype=np.int64)
 
     def note(r: str, v: int):
         if r in r_index and v < INT_INF:
             i = r_index[r]
-            max_per_resource[i] = max(max_per_resource[i], abs(v))
-            all_vals[i].append(abs(v))
+            av = abs(int(v))
+            max_per_resource[i] = max(max_per_resource[i], av)
+            gcd_per_resource[i] = math.gcd(int(gcd_per_resource[i]), av)
 
     nodes: list = [snapshot.cluster_queues[n] for n in cq_names] + cohorts
     for node in nodes:
@@ -158,11 +160,21 @@ def pack_cycle(snapshot: Snapshot, heads: list[Info],
             for r, v in psr.requests.items():
                 note(r, v)
 
+    # Exact scaling: divide by the GCD of every observed quantity, so
+    # scaled comparisons are bit-identical to the host's (hard part (e),
+    # SURVEY §7).  If even GCD scaling can't fit int32 (with ×64 headroom
+    # for sums across the tree), fall back to lossy power-of-two scaling
+    # and mark the pack inexact — the solver then defers to the host.
     scale = np.ones(R, dtype=np.int64)
+    exact = True
+    limit = I32_MAX // 64
     for i in range(R):
-        # headroom ×64: sums across the tree must also stay in int32
-        while max_per_resource[i] // scale[i] > I32_MAX // 64:
+        if max_per_resource[i] <= limit:
+            continue
+        scale[i] = max(1, int(gcd_per_resource[i]))
+        while max_per_resource[i] // scale[i] > limit:
             scale[i] *= 2
+            exact = False
 
     def scaled(r: str, v) -> int:
         if v >= INT_INF:
@@ -186,12 +198,8 @@ def pack_cycle(snapshot: Snapshot, heads: list[Info],
     nominal_cq = np.zeros((C, F), dtype=np.int32)
 
     for ni, node in enumerate(nodes):
-        if ni < C:
-            p = node.parent
-            parent[ni] = cohort_idx[id(p)] if p is not None else -1
-        else:
-            p = node.parent
-            parent[ni] = cohort_idx[id(p)] if p is not None else -1
+        p = node.parent
+        parent[ni] = cohort_idx[id(p)] if p is not None else -1
         rn = node.resource_node
         for fr, fi in fr_index.items():
             sq = rn.subtree_quota.get(fr, 0)
@@ -255,9 +263,11 @@ def pack_cycle(snapshot: Snapshot, heads: list[Info],
         wl_cq[wi] = cq_idx.get(h.cluster_queue, -1)
         for psr in h.total_requests:
             for r, v in psr.requests.items():
+                # the implicit "pods" request only participates when the
+                # head's CQ covers it (flavorassigner.go:226)
+                if r == "pods" and h.cluster_queue not in cq_covers_pods:
+                    continue
                 wl_requests[wi, r_index[r]] += scaled_ceil(r, v)
-            if h.cluster_queue in cq_covers_pods:
-                wl_requests[wi, r_index["pods"]] += psr.count
         wl_priority[wi] = h.obj.priority
         wl_timestamp[wi] = (ordering.queue_order_timestamp(h.obj)
                             if ordering is not None else h.obj.creation_time)
@@ -272,4 +282,5 @@ def pack_cycle(snapshot: Snapshot, heads: list[Info],
         cq_can_preempt_borrow=cq_can_preempt_borrow,
         wl_count=len(heads), wl_cq=wl_cq, wl_requests=wl_requests,
         wl_priority=wl_priority, wl_timestamp=wl_timestamp, wl_keys=wl_keys,
+        exact=exact,
     )

@@ -11,8 +11,6 @@ from __future__ import annotations
 import jax
 import jax.numpy as jnp
 
-BIG = 2**31 // 64  # "unlimited" sentinel, matches packer headroom
-
 
 def available_all(usage: jnp.ndarray, subtree: jnp.ndarray,
                   guaranteed: jnp.ndarray, borrow_cap: jnp.ndarray,
@@ -41,19 +39,6 @@ def available_all(usage: jnp.ndarray, subtree: jnp.ndarray,
         return jnp.where(is_root, root_avail, local + parent_avail)
 
     return jax.lax.fori_loop(0, depth, body, avail)
-
-
-def potential_available_all(subtree: jnp.ndarray, guaranteed: jnp.ndarray,
-                            borrow_cap: jnp.ndarray, has_blim: jnp.ndarray,
-                            parent: jnp.ndarray, depth: int) -> jnp.ndarray:
-    """potentialAvailable() for every node (resource_node.go:108).
-
-    Usage-free, so ``usage=0``: local = guaranteed, blim cap =
-    subtree - guaranteed + blimit = borrow_cap.
-    """
-    zero = jnp.zeros_like(subtree)
-    return available_all(zero, subtree, guaranteed, borrow_cap, has_blim,
-                         parent, depth)
 
 
 def add_usage_chain(usage: jnp.ndarray, node: jnp.ndarray, delta: jnp.ndarray,

@@ -46,6 +46,15 @@ class CycleSolver:
                 # the host can split flavors across pod sets; the device
                 # currently solves the summed request against one flavor
                 return False
+            last = h.last_assignment
+            if last is not None and last.pending_flavors:
+                # effective fungibility resume state: the host would start
+                # the flavor walk mid-list (flavorassigner.go:359-366);
+                # the device always scans from slot 0
+                cq = snapshot.cq(h.cluster_queue)
+                if (cq is not None and
+                        last.cluster_queue_generation >= cq.allocatable_generation):
+                    return False
             for ps in h.obj.pod_sets:
                 if ps.topology_request is not None:
                     return False
@@ -79,6 +88,10 @@ class CycleSolver:
             self.stats["host_fallbacks"] += 1
             return None
         packed = pack_cycle(snapshot, heads, self.ordering)
+        if not packed.exact:
+            # lossy int32 scaling could deny fits the host grants
+            self.stats["host_fallbacks"] += 1
+            return None
         (_admitted, _slots, _borrows, preempt_possible,
          fit_slot0, borrows0) = solve_cycle(
             packed.usage0, packed.subtree_quota, packed.guaranteed,
@@ -104,21 +117,29 @@ class CycleSolver:
             h = heads[wi]
             cq = snapshot.cq(h.cluster_queue)
             rg = cq.spec.resource_groups[0]
+            covers_pods = "pods" in rg.covered_resources
             flavor_name = rg.flavors[int(fit_slot0[wi])].name
             assignment = Assignment()
             assignment.borrowing = bool(borrows0[wi])
             assignment.last_state.cluster_queue_generation = cq.allocatable_generation
             for psr in h.total_requests:
+                # mirror the host's implicit "pods" handling
+                # (flavorassigner.go:226 / _assign_flavors)
+                reqs = dict(psr.requests)
+                if covers_pods:
+                    reqs["pods"] = psr.count
+                else:
+                    reqs.pop("pods", None)
                 ps_res = PodSetAssignmentResult(
-                    name=psr.name, requests=Requests(psr.requests),
+                    name=psr.name, requests=Requests(reqs),
                     count=psr.count)
-                for res in psr.requests:
+                for res in reqs:
                     ps_res.flavors[res] = FlavorAssignmentDecision(
                         name=flavor_name, mode=Mode.FIT,
                         borrow=bool(borrows0[wi]))
                     fr = FlavorResource(flavor_name, res)
                     assignment.usage[fr] = (assignment.usage.get(fr, 0)
-                                            + psr.requests[res])
+                                            + reqs[res])
                 assignment.pod_sets.append(ps_res)
             out[h.key] = assignment
         return out

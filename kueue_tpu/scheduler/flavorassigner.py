@@ -225,6 +225,10 @@ class FlavorAssigner:
             reqs = Requests(psr.requests)
             if rg_by_resource(self.cq, "pods") is not None:
                 reqs["pods"] = psr.count
+            else:
+                # implicit pods resource only participates when the CQ
+                # covers it (reference flavorassigner.go:226)
+                reqs.pop("pods", None)
             ps_result = PodSetAssignmentResult(
                 name=psr.name, requests=reqs, count=psr.count)
             for res in sorted(reqs):

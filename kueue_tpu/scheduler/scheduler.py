@@ -243,10 +243,12 @@ class Scheduler:
             if assignment is not None:
                 e.assignment = assignment
                 e.inadmissible_msg = ""
+                e.info.last_assignment = e.assignment.last_state
             else:
-                e.assignment = Assignment()
-                e.inadmissible_msg = "insufficient quota (batched solver)"
-            e.info.last_assignment = e.assignment.last_state
+                # the device only proves Fit; recompute non-fitting entries
+                # on the host for exact inadmissible reasons and
+                # fungibility resume state
+                self._assign_entry(e, snapshot)
 
     @staticmethod
     def _has_retry_or_rejected_checks(wl: Workload) -> bool:

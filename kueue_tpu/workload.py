@@ -130,13 +130,30 @@ class Info:
             assignments = {}
         for ps in wl.pod_sets:
             asg = assignments.get(ps.name)
-            count = asg.count if asg is not None and asg.count else ps.count
-            count = max(0, count - self._reclaim_count(ps.name))
+            if asg is not None and asg.resource_usage:
+                # admitted: the admission's per-PodSet resource usage is
+                # authoritative (reference workload.go
+                # totalRequestsFromAdmission) — it already carries the
+                # implicit "pods" resource for CQs that cover it
+                count = asg.count if asg.count else ps.count
+                psr = PodSetResources(
+                    name=ps.name, requests=Requests(asg.resource_usage),
+                    count=count, flavors=dict(asg.flavors),
+                    topology_request=ps.topology_request)
+                target = max(0, count - self._reclaim_count(ps.name))
+                if target != count:
+                    psr = psr.scaled_to(target)
+                out.append(psr)
+                continue
+            count = max(0, ps.count - self._reclaim_count(ps.name))
             per_pod = _apply_transformations(Requests(ps.requests), self.opts)
             total = Requests({k: v * count for k, v in per_pod.items()})
-            flavors = dict(asg.flavors) if asg is not None else {}
+            # implicit pods resource (reference workload.go
+            # totalRequestsFromPodSets); the flavor assigner drops it for
+            # CQs that don't cover "pods"
+            total["pods"] = count
             out.append(PodSetResources(
-                name=ps.name, requests=total, count=count, flavors=flavors,
+                name=ps.name, requests=total, count=count, flavors={},
                 topology_request=ps.topology_request))
         return out
 
