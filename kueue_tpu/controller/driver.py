@@ -92,6 +92,7 @@ class Driver:
         self.scheduler.preemptor.apply_preemption = self._apply_preemption
         # durable store: the CRD-status equivalent
         self.workloads: dict[str, Workload] = {}
+        self.priority_classes: dict[str, object] = {}
         self.events: list[tuple[str, str, str]] = []  # (kind, key, note)
         self.metrics = metrics.Registry()
 
@@ -106,6 +107,13 @@ class Driver:
     def apply_topology(self, topology: Topology) -> None:
         self.cache.add_or_update_topology(topology)
         self._wake_all()
+
+    def apply_workload_priority_class(self, pc) -> None:
+        """reference WorkloadPriorityClass (pkg/util/priority)."""
+        self.priority_classes[pc.name] = pc
+
+    def resolve_priority_class(self, name: str):
+        return self.priority_classes.get(name)
 
     def apply_admission_check(self, check: AdmissionCheck) -> None:
         self.cache.add_or_update_admission_check(check)
@@ -178,6 +186,33 @@ class Driver:
                 self.metrics.release_admitted(cq_name)
             self.queues.queue_inadmissible_workloads([cq_name])
         self.queues.delete_workload(wl)
+
+    def update_reclaimable_pods(self, key: str, counts: dict[str, int]) -> None:
+        """reference workload.UpdateReclaimablePods (KEP 78): shrink the
+        quota charged for pods that finished early."""
+        from ..api.types import ReclaimablePod
+        wl = self.workloads.get(key)
+        if wl is None or wl.is_finished:
+            return
+        existing = {rp.name: rp.count for rp in wl.reclaimable_pods}
+        changed = False
+        for name, count in counts.items():
+            # reclaim counts only grow (reference validation)
+            if count > existing.get(name, 0):
+                existing[name] = count
+                changed = True
+        if not changed:
+            return
+        wl.reclaimable_pods = [ReclaimablePod(name=n, count=c)
+                               for n, c in sorted(existing.items())]
+        if wl.admission is not None:
+            # re-charge the cache with the shrunk usage
+            self.cache.add_or_update_workload(Info(wl))
+            if wl.admission.cluster_queue:
+                self.queues.queue_inadmissible_workloads(
+                    [wl.admission.cluster_queue])
+        else:
+            self.queues.add_or_update_workload(wl)
 
     def deactivate_workload(self, key: str) -> None:
         wl = self.workloads.get(key)

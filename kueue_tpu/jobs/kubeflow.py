@@ -1,0 +1,98 @@
+"""Kubeflow training-operator family + MPIJob (reference
+pkg/controller/jobs/kubeflow, 1,165 LoC + mpijob 515 LoC).
+
+All kubeflow kinds share one adapter over replica specs (the reference's
+kubeflowjob common adapter): each replica role (Master/Worker/PS/...)
+becomes a PodSet.  The reference wires TFJob, PyTorchJob, XGBoostJob,
+PaddleJob and JAXJob through this adapter; MPIJob has the same shape with
+Launcher/Worker roles.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Optional
+
+from ..jobframework.interface import IntegrationCallbacks, register_integration
+from .base import PodTemplate, TemplateJob
+
+
+@dataclass
+class ReplicaSpec:
+    role: str                 # e.g. "Master", "Worker", "PS", "Launcher"
+    replicas: int = 1
+    requests: dict[str, int] = field(default_factory=dict)
+    topology_request: object = None
+
+
+class KubeflowJob(TemplateJob):
+    """Common adapter (reference kubeflowjob.KubeflowJob)."""
+
+    kind = "KubeflowJob"
+    # roles ordered first in the workload's pod sets (reference orders
+    # Master before Worker for stable PodSet naming)
+    role_order: tuple[str, ...] = ()
+
+    def __init__(self, name: str, replicas: list[ReplicaSpec], **kw):
+        order = {r: i for i, r in enumerate(self.role_order)}
+        replicas = sorted(replicas,
+                          key=lambda r: order.get(r.role, len(order)))
+        templates = [PodTemplate(name=r.role.lower(), count=r.replicas,
+                                 requests=dict(r.requests),
+                                 topology_request=r.topology_request)
+                     for r in replicas]
+        super().__init__(name, templates=templates, **kw)
+        self.replicas = replicas
+        self.condition: Optional[tuple[str, bool]] = None  # (message, success)
+
+    def mark_succeeded(self, message: str = "") -> None:
+        self.condition = (message or f"{self.kind} finished", True)
+
+    def mark_failed(self, message: str = "") -> None:
+        self.condition = (message or f"{self.kind} failed", False)
+
+    def finished(self) -> tuple[str, bool, bool]:
+        if self.condition is None:
+            return "", False, False
+        message, success = self.condition
+        return message, success, True
+
+
+class TFJob(KubeflowJob):
+    kind = "TFJob"
+    role_order = ("Master", "Chief", "PS", "Worker", "Evaluator")
+
+
+class PyTorchJob(KubeflowJob):
+    kind = "PyTorchJob"
+    role_order = ("Master", "Worker")
+
+
+class XGBoostJob(KubeflowJob):
+    kind = "XGBoostJob"
+    role_order = ("Master", "Worker")
+
+
+class PaddleJob(KubeflowJob):
+    kind = "PaddleJob"
+    role_order = ("Master", "Worker")
+
+
+class JAXJob(KubeflowJob):
+    kind = "JAXJob"
+    role_order = ("Worker",)
+
+
+class MPIJob(KubeflowJob):
+    kind = "MPIJob"
+    role_order = ("Launcher", "Worker")
+
+
+for _cls, _name in [(TFJob, "kubeflow.org/tfjob"),
+                    (PyTorchJob, "kubeflow.org/pytorchjob"),
+                    (XGBoostJob, "kubeflow.org/xgboostjob"),
+                    (PaddleJob, "kubeflow.org/paddlejob"),
+                    (JAXJob, "kubeflow.org/jaxjob"),
+                    (MPIJob, "kubeflow.org/mpijob")]:
+    register_integration(IntegrationCallbacks(
+        name=_name, gvk=_cls.kind, new_job=_cls))

@@ -1,0 +1,76 @@
+"""RayJob / RayCluster integrations (reference pkg/controller/jobs/rayjob
+623 LoC, raycluster 531 LoC).
+
+A Ray cluster contributes one PodSet for the head plus one per worker
+group; a RayJob wraps a cluster spec and finishes with the job's
+terminal status, while a RayCluster is a long-running service that only
+finishes on deletion.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Optional
+
+from ..jobframework.interface import IntegrationCallbacks, register_integration
+from .base import PodTemplate, TemplateJob
+
+
+@dataclass
+class WorkerGroupSpec:
+    name: str
+    replicas: int = 1
+    requests: dict[str, int] = field(default_factory=dict)
+
+
+def _cluster_templates(head_requests: dict[str, int],
+                       worker_groups: list[WorkerGroupSpec]) -> list[PodTemplate]:
+    templates = [PodTemplate(name="head", count=1,
+                             requests=dict(head_requests))]
+    templates += [PodTemplate(name=wg.name, count=wg.replicas,
+                              requests=dict(wg.requests))
+                  for wg in worker_groups]
+    return templates
+
+
+class RayJob(TemplateJob):
+    kind = "RayJob"
+
+    def __init__(self, name: str, head_requests: dict[str, int],
+                 worker_groups: list[WorkerGroupSpec], **kw):
+        super().__init__(name, templates=_cluster_templates(
+            head_requests, worker_groups), **kw)
+        self.job_status: Optional[str] = None   # SUCCEEDED | FAILED
+
+    def mark_status(self, status: str) -> None:
+        self.job_status = status
+
+    def finished(self) -> tuple[str, bool, bool]:
+        if self.job_status == "SUCCEEDED":
+            return "RayJob succeeded", True, True
+        if self.job_status == "FAILED":
+            return "RayJob failed", False, True
+        return "", False, False
+
+
+class RayCluster(TemplateJob):
+    """A serving-style cluster: admitted while it exists."""
+
+    kind = "RayCluster"
+
+    def __init__(self, name: str, head_requests: dict[str, int],
+                 worker_groups: list[WorkerGroupSpec], **kw):
+        super().__init__(name, templates=_cluster_templates(
+            head_requests, worker_groups), **kw)
+        self.deleted = False
+
+    def finished(self) -> tuple[str, bool, bool]:
+        if self.deleted:
+            return "RayCluster deleted", True, True
+        return "", False, False
+
+
+register_integration(IntegrationCallbacks(
+    name="ray.io/rayjob", gvk=RayJob.kind, new_job=RayJob))
+register_integration(IntegrationCallbacks(
+    name="ray.io/raycluster", gvk=RayCluster.kind, new_job=RayCluster))

@@ -1,0 +1,232 @@
+"""Job-integration framework tests (reference
+pkg/controller/jobframework/reconciler_test.go patterns + per-integration
+suites): the job↔workload state machine end-to-end against the driver."""
+
+import pytest
+
+from kueue_tpu.api.types import (
+    ClusterQueue,
+    FlavorQuotas,
+    LocalQueue,
+    ResourceFlavor,
+    ResourceGroup,
+    ResourceQuota,
+    WorkloadPriorityClass,
+)
+from kueue_tpu.controller.driver import Driver
+from kueue_tpu.jobframework import JobManager, workload_name_for_job
+from kueue_tpu.jobs import (
+    BatchJob,
+    Deployment,
+    JobSet,
+    PodGroup,
+    PyTorchJob,
+    RayJob,
+    ReplicaSpec,
+    ReplicatedJobSpec,
+)
+from kueue_tpu.jobs.pod import Pod
+from kueue_tpu.jobs.ray import WorkerGroupSpec
+
+
+class FakeClock:
+    def __init__(self, now=1000.0):
+        self.t = now
+
+    def __call__(self):
+        return self.t
+
+
+def make_driver(nominal=10_000, node_labels=None):
+    d = Driver(clock=FakeClock())
+    d.apply_resource_flavor(ResourceFlavor(
+        name="default", node_labels=node_labels or {}))
+    d.apply_cluster_queue(ClusterQueue(
+        name="cq", resource_groups=[ResourceGroup(
+            covered_resources=["cpu"],
+            flavors=[FlavorQuotas(name="default", resources={
+                "cpu": ResourceQuota(nominal=nominal)})])]))
+    d.apply_local_queue(LocalQueue(name="lq", cluster_queue="cq"))
+    return d
+
+
+def test_batch_job_lifecycle():
+    d = make_driver(node_labels={"cloud.com/type": "tpu-v5e"})
+    m = JobManager(d)
+    job = BatchJob("train", parallelism=2, requests={"cpu": 1000},
+                   queue="lq")
+    assert job.is_suspended()
+    m.upsert(job)
+    m.run()
+    # admitted → started with flavor node selectors injected
+    assert not job.is_suspended()
+    assert job.templates[0].node_selector == {"cloud.com/type": "tpu-v5e"}
+    wl_key = m.reconciler.workload_key_for(job)
+    assert wl_key in d.admitted_keys()
+    # completion finishes the workload and releases quota
+    job.complete_pods(2)
+    m.run()
+    assert d.workload(wl_key).is_finished
+    assert all(v == 0 for v in d.cache.usage("cq").values())
+
+
+def test_job_without_queue_name_not_managed():
+    d = make_driver()
+    m = JobManager(d)
+    job = BatchJob("unmanaged", parallelism=1, requests={"cpu": 1000})
+    m.upsert(job)
+    m.run()
+    assert m.reconciler.workload_key_for(job) not in d.workloads
+
+
+def test_unsuspended_job_without_workload_is_gated():
+    d = make_driver()
+    m = JobManager(d)
+    job = BatchJob("sneaky", parallelism=1, requests={"cpu": 1000},
+                   queue="lq")
+    job.suspended = False
+    m.upsert(job)
+    assert job.is_suspended()     # stopped: no matching workload
+
+
+def test_eviction_stops_job_and_restores_template():
+    d = make_driver(nominal=2000, node_labels={"zone": "a"})
+    m = JobManager(d)
+    low = BatchJob("low", parallelism=2, requests={"cpu": 1000}, queue="lq")
+    m.upsert(low)
+    m.run()
+    assert not low.is_suspended()
+    assert low.templates[0].node_selector == {"zone": "a"}
+
+    # a higher-priority job preempts it
+    d.apply_workload_priority_class(WorkloadPriorityClass(
+        name="high", value=1000))
+    d.apply_cluster_queue(ClusterQueue(
+        name="cq", resource_groups=[ResourceGroup(
+            covered_resources=["cpu"],
+            flavors=[FlavorQuotas(name="default", resources={
+                "cpu": ResourceQuota(nominal=2000)})])],
+        preemption=__import__("kueue_tpu.api.types", fromlist=["x"])
+        .PreemptionPolicy(within_cluster_queue=__import__(
+            "kueue_tpu.api.types", fromlist=["x"]).WithinClusterQueue
+            .LOWER_PRIORITY)))
+    high = BatchJob("high", parallelism=2, requests={"cpu": 1000},
+                    queue="lq", priority_class="high")
+    m.upsert(high)
+    m.run()
+    assert not high.is_suspended()
+    assert low.is_suspended()
+    assert low.templates[0].node_selector == {}   # template restored
+    wl = d.workload(m.reconciler.workload_key_for(high))
+    assert wl.priority == 1000
+
+
+def test_reclaimable_pods_release_quota():
+    d = make_driver(nominal=3000)
+    m = JobManager(d)
+    a = BatchJob("a", parallelism=3, requests={"cpu": 1000}, queue="lq")
+    m.upsert(a)
+    m.run()
+    assert not a.is_suspended()
+    b = BatchJob("b", parallelism=1, requests={"cpu": 1000}, queue="lq")
+    m.upsert(b)
+    m.run()
+    assert b.is_suspended()       # no room yet
+    a.complete_pods(2)            # 2 of 3 pods done → reclaimable
+    m.run()
+    assert not b.is_suspended()   # reclaimed quota admits b
+
+
+def test_podgroup_gang_admission_and_ungating():
+    d = make_driver(nominal=4000)
+    m = JobManager(d)
+    group = PodGroup("workers", total_count=3, queue="lq")
+    for i in range(3):
+        group.add_pod(Pod(name=f"p{i}", requests={"cpu": 1000}))
+    assert all(p.gated for p in group.pods)
+    m.upsert(group)
+    m.run()
+    assert all(not p.gated for p in group.pods)
+    assert all(p.phase == "Running" for p in group.pods)
+    for p in group.pods:
+        p.phase = "Succeeded"
+    m.run()
+    wl_key = m.reconciler.workload_key_for(group)
+    assert d.workload(wl_key).is_finished
+
+
+def test_podgroup_too_big_stays_gated():
+    d = make_driver(nominal=2000)
+    m = JobManager(d)
+    group = PodGroup("big", total_count=3, queue="lq")
+    for i in range(3):
+        group.add_pod(Pod(name=f"p{i}", requests={"cpu": 1000}))
+    m.upsert(group)
+    m.run()
+    assert all(p.gated for p in group.pods)
+
+
+def test_jobset_multi_podset():
+    d = make_driver(nominal=10_000)
+    m = JobManager(d)
+    js = JobSet("set", replicated_jobs=[
+        ReplicatedJobSpec(name="driver", replicas=1, parallelism=1,
+                          requests={"cpu": 1000}),
+        ReplicatedJobSpec(name="workers", replicas=2, parallelism=4,
+                          requests={"cpu": 500}),
+    ], queue="lq")
+    m.upsert(js)
+    m.run()
+    assert not js.is_suspended()
+    wl = d.workload(m.reconciler.workload_key_for(js))
+    assert [(ps.name, ps.count) for ps in wl.pod_sets] == [
+        ("driver", 1), ("workers", 8)]
+    js.complete_replicated_job("driver")
+    js.complete_replicated_job("workers")
+    m.run()
+    assert wl.is_finished
+
+
+def test_pytorch_job_role_ordering():
+    d = make_driver()
+    m = JobManager(d)
+    job = PyTorchJob("pt", replicas=[
+        ReplicaSpec(role="Worker", replicas=3, requests={"cpu": 1000}),
+        ReplicaSpec(role="Master", replicas=1, requests={"cpu": 500}),
+    ], queue="lq")
+    m.upsert(job)
+    m.run()
+    wl = d.workload(m.reconciler.workload_key_for(job))
+    assert [ps.name for ps in wl.pod_sets] == ["master", "worker"]
+    job.mark_succeeded()
+    m.run()
+    assert wl.is_finished
+
+
+def test_ray_job_and_deployment():
+    d = make_driver()
+    m = JobManager(d)
+    rj = RayJob("ray", head_requests={"cpu": 1000},
+                worker_groups=[WorkerGroupSpec(name="gpu-workers",
+                                               replicas=2,
+                                               requests={"cpu": 2000})],
+                queue="lq")
+    dep = Deployment("serve", replicas=2, requests={"cpu": 500}, queue="lq")
+    m.upsert(rj)
+    m.upsert(dep)
+    m.run()
+    assert not rj.is_suspended() and not dep.is_suspended()
+    rj.mark_status("SUCCEEDED")
+    m.run()
+    assert d.workload(m.reconciler.workload_key_for(rj)).is_finished
+    # the deployment keeps holding quota (serving)
+    assert not d.workload(m.reconciler.workload_key_for(dep)).is_finished
+
+
+def test_workload_name_deterministic_and_bounded():
+    n1 = workload_name_for_job("BatchJob", "my-job")
+    n2 = workload_name_for_job("BatchJob", "my-job")
+    assert n1 == n2 and len(n1) <= 63
+    long = workload_name_for_job("BatchJob", "x" * 100)
+    assert len(long) <= 63
+    assert long != workload_name_for_job("BatchJob", "x" * 99)
