@@ -1,0 +1,205 @@
+"""MultiKueue: multi-cluster dispatch (reference
+pkg/controller/admissionchecks/multikueue, KEP 693).
+
+Worker clusters are full in-process Drivers (the reference's multikueue
+integration tests run multiple envtest apiservers in one process the same
+way — SURVEY §4.3).  The dispatch protocol mirrors
+multikueue/workload.go:
+
+1. a workload reserves quota on the manager; its CQ carries a MultiKueue
+   AdmissionCheck;
+2. the controller mirrors the workload to every cluster in the check's
+   MultiKueueConfig (nomination);
+3. the first worker to reserve quota wins; mirrors elsewhere are deleted;
+4. the check flips Ready; the local job stays suspended (managedBy);
+5. remote status (admitted / finished) is copied back; a lost worker
+   ejects the assignment after ``worker_lost_timeout`` and the check
+   returns to Pending for re-dispatch (multikueuecluster.go:255 GC +
+   workload.go ejection).
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Optional
+
+from ..api.types import (
+    AdmissionCheckState,
+    MultiKueueConfig,
+    Workload,
+)
+
+MULTIKUEUE_CONTROLLER_NAME = "kueue.x-k8s.io/multikueue"
+
+
+@dataclass
+class WorkerCluster:
+    """A remote cluster: a full Driver behind a connection that can drop
+    (reference multikueuecluster.go remoteClient)."""
+    name: str
+    driver: object                    # a kueue_tpu Driver
+    active: bool = True
+    lost_since: Optional[float] = None
+
+    def mark_lost(self, now: float) -> None:
+        if self.active:
+            self.active = False
+            self.lost_since = now
+
+    def reconnect(self) -> None:
+        self.active = True
+        self.lost_since = None
+
+
+@dataclass
+class _Assignment:
+    cluster: str
+    nominated: list[str] = field(default_factory=list)
+
+
+class MultiKueueController:
+    """reference multikueue/workload.go wlReconciler."""
+
+    def __init__(self, manager_driver, check_name: str,
+                 config: MultiKueueConfig,
+                 clusters: dict[str, WorkerCluster],
+                 origin: str = "multikueue",
+                 worker_lost_timeout: float = 300.0):
+        self.manager = manager_driver
+        self.check_name = check_name
+        self.config = config
+        self.clusters = clusters
+        self.origin = origin
+        self.worker_lost_timeout = worker_lost_timeout
+        self.assignments: dict[str, _Assignment] = {}
+
+    # ------------------------------------------------------------------
+
+    def _relevant(self, wl: Workload) -> bool:
+        return (self.check_name in wl.admission_check_states
+                and wl.has_quota_reservation and not wl.is_finished)
+
+    def _mirror(self, wl: Workload) -> Workload:
+        remote = Workload(
+            name=wl.name, namespace=wl.namespace, queue_name=wl.queue_name,
+            pod_sets=[__import__("copy").deepcopy(ps) for ps in wl.pod_sets],
+            priority=wl.priority, creation_time=wl.creation_time)
+        return remote
+
+    def reconcile(self) -> None:
+        now = self.manager.clock()
+        # connection health → eject assignments on lost workers
+        for name, cluster in self.clusters.items():
+            if (not cluster.active and cluster.lost_since is not None
+                    and now - cluster.lost_since > self.worker_lost_timeout):
+                self._eject_cluster(name)
+
+        for key, wl in list(self.manager.workloads.items()):
+            if not self._relevant(wl):
+                if key in self.assignments:
+                    self._cleanup(key)
+                continue
+            state = wl.admission_check_states[self.check_name]
+            asg = self.assignments.get(key)
+            if asg is None:
+                self._nominate(key, wl)
+            else:
+                self._sync(key, wl, state.state, asg)
+
+    # ------------------------------------------------------------------
+
+    def _nominate(self, key: str, wl: Workload) -> None:
+        """Create mirrors on every configured active cluster
+        (workload.go nominateAndSynchronizeWorkers)."""
+        nominated = []
+        for cname in self.config.clusters:
+            cluster = self.clusters.get(cname)
+            if cluster is None or not cluster.active:
+                continue
+            if wl.key not in cluster.driver.workloads:
+                cluster.driver.create_workload(self._mirror(wl))
+            nominated.append(cname)
+        if not nominated:
+            return
+        self.assignments[key] = _Assignment(cluster="", nominated=nominated)
+
+    def _sync(self, key: str, wl: Workload, state: AdmissionCheckState,
+              asg: _Assignment) -> None:
+        # give each nominated worker a scheduling chance, then pick the
+        # first with quota reserved (workload.go: first to reserve wins)
+        if not asg.cluster:
+            for cname in asg.nominated:
+                cluster = self.clusters.get(cname)
+                if cluster is None or not cluster.active:
+                    continue
+                remote = cluster.driver.workloads.get(key)
+                if remote is not None and remote.has_quota_reservation:
+                    asg.cluster = cname
+                    break
+            if asg.cluster:
+                # delete the losing mirrors
+                for cname in asg.nominated:
+                    if cname != asg.cluster:
+                        self._delete_remote(cname, key)
+                asg.nominated = [asg.cluster]
+                self.manager.set_admission_check_state(
+                    key, self.check_name, AdmissionCheckState.READY,
+                    f'The workload got reservation on "{asg.cluster}"')
+            return
+
+        cluster = self.clusters.get(asg.cluster)
+        if cluster is None or not cluster.active:
+            return  # lost; ejection handled by the timeout scan
+        remote = cluster.driver.workloads.get(key)
+        if remote is None:
+            # remote deleted under us → re-dispatch
+            self._reset(key)
+            return
+        if remote.is_finished:
+            msg = remote.conditions.get("Finished")
+            self.manager.finish_workload(
+                key, msg.message if msg else "Finished on worker")
+            self._cleanup(key)
+
+    # ------------------------------------------------------------------
+
+    def _delete_remote(self, cname: str, key: str) -> None:
+        cluster = self.clusters.get(cname)
+        if cluster is not None and cluster.active:
+            cluster.driver.delete_workload(key)
+
+    def _cleanup(self, key: str) -> None:
+        asg = self.assignments.pop(key, None)
+        if asg is None:
+            return
+        for cname in asg.nominated:
+            wl = self.manager.workloads.get(key)
+            if wl is None or not wl.is_finished:
+                self._delete_remote(cname, key)
+
+    def _reset(self, key: str) -> None:
+        self.assignments.pop(key, None)
+        self.manager.set_admission_check_state(
+            key, self.check_name, AdmissionCheckState.RETRY,
+            "Lost the remote reservation; will re-dispatch")
+
+    def _eject_cluster(self, cname: str) -> None:
+        """Worker lost beyond timeout: requeue everything assigned to it
+        (workload.go workerLostTimeout ejection)."""
+        for key, asg in list(self.assignments.items()):
+            if asg.cluster == cname or cname in asg.nominated:
+                self._reset(key)
+
+    # ------------------------------------------------------------------
+
+    def run_gc(self) -> None:
+        """Remote GC (multikueuecluster.go:255 runGC): delete worker
+        mirrors whose manager workload is gone."""
+        managed = set(self.manager.workloads)
+        for cluster in self.clusters.values():
+            if not cluster.active:
+                continue
+            for key in list(cluster.driver.workloads):
+                wl = cluster.driver.workloads[key]
+                if key not in managed and not wl.is_finished:
+                    cluster.driver.delete_workload(key)

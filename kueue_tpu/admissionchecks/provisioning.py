@@ -1,0 +1,151 @@
+"""ProvisioningRequest admission-check controller (reference
+pkg/controller/admissionchecks/provisioning, KEP 1136).
+
+For every workload with quota reserved whose CQ carries a provisioning
+check, the controller owns one ProvisioningRequest per attempt
+(syncOwnedProvisionRequest, controller.go:226).  A pluggable capacity
+backend (the cluster-autoscaler stand-in) flips request states; on
+Provisioned the check turns Ready and PodSetUpdates inject the
+provisioning node selectors; on failure the controller retries with
+exponential backoff up to the config's limit, then rejects
+(controller.go:344 retry logic, :659 podSetUpdates).
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Callable, Optional
+
+from ..api.types import (
+    AdmissionCheckState,
+    ProvisioningRequestConfig,
+    Workload,
+)
+
+PROVISIONING_CONTROLLER_NAME = "kueue.x-k8s.io/provisioning-request"
+
+
+@dataclass
+class ProvisioningRequest:
+    """The autoscaler-facing object (stand-in for autoscaler.x-k8s.io
+    ProvisioningRequest)."""
+    name: str
+    workload_key: str
+    check_name: str
+    attempt: int = 1
+    provisioning_class: str = ""
+    parameters: dict[str, str] = field(default_factory=dict)
+    pod_sets: list = field(default_factory=list)
+    state: str = "Pending"        # Pending|Accepted|Provisioned|Failed|
+    #                               BookingExpired|CapacityRevoked
+    failure_message: str = ""
+
+
+def request_name(wl_name: str, check: str, attempt: int) -> str:
+    """reference provisioning/controller.go ProvisioningRequestName."""
+    return f"{wl_name}-{check}-{attempt}"
+
+
+class ProvisioningController:
+    """reference provisioning/controller.go Controller."""
+
+    def __init__(self, driver, check_name: str,
+                 config: ProvisioningRequestConfig,
+                 capacity_backend: Optional[Callable[[ProvisioningRequest], None]] = None):
+        self.driver = driver
+        self.check_name = check_name
+        self.config = config
+        self.capacity_backend = capacity_backend
+        self.requests: dict[str, ProvisioningRequest] = {}
+        # wl key → (attempt, not_before_time)
+        self.retry_state: dict[str, tuple[int, float]] = {}
+
+    # ------------------------------------------------------------------
+
+    def _relevant(self, wl: Workload) -> bool:
+        return (self.check_name in wl.admission_check_states
+                and wl.has_quota_reservation and not wl.is_finished)
+
+    def _backoff(self, attempt: int) -> float:
+        rs = self.config.retry_strategy
+        return min(rs.backoff_base_seconds * (2 ** (attempt - 1)),
+                   rs.backoff_max_seconds)
+
+    def reconcile(self) -> None:
+        now = self.driver.clock()
+        live = set()
+        for key, wl in list(self.driver.workloads.items()):
+            if not self._relevant(wl):
+                continue
+            state = wl.admission_check_states[self.check_name].state
+            if state == AdmissionCheckState.READY:
+                live.add((key, self._attempt(key)))
+                continue
+            attempt, not_before = self.retry_state.get(key, (1, 0.0))
+            if now < not_before:
+                continue
+            rname = request_name(wl.name, self.check_name, attempt)
+            live.add((key, attempt))
+            req = self.requests.get(rname)
+            if req is None:
+                req = ProvisioningRequest(
+                    name=rname, workload_key=key,
+                    check_name=self.check_name, attempt=attempt,
+                    provisioning_class=self.config.provisioning_class_name,
+                    parameters=dict(self.config.parameters),
+                    pod_sets=[(ps.name, ps.count) for ps in wl.pod_sets])
+                self.requests[rname] = req
+                if self.capacity_backend is not None:
+                    self.capacity_backend(req)
+            self._sync_check_state(key, wl, req, now)
+
+        # GC requests whose workload/attempt is gone (controller.go GC)
+        for rname, req in list(self.requests.items()):
+            if (req.workload_key, req.attempt) not in live:
+                wl = self.driver.workloads.get(req.workload_key)
+                if wl is None or not self._relevant(wl):
+                    del self.requests[rname]
+
+    def _attempt(self, key: str) -> int:
+        return self.retry_state.get(key, (1, 0.0))[0]
+
+    # ------------------------------------------------------------------
+
+    def _sync_check_state(self, key: str, wl: Workload,
+                          req: ProvisioningRequest, now: float) -> None:
+        if req.state == "Provisioned":
+            self._set_ready(key, wl)
+        elif req.state in ("Failed", "BookingExpired", "CapacityRevoked"):
+            attempt = req.attempt
+            limit = self.config.retry_strategy.backoff_limit_count
+            if attempt < limit:
+                self.retry_state[key] = (attempt + 1,
+                                         now + self._backoff(attempt))
+                self.driver.set_admission_check_state(
+                    key, self.check_name, AdmissionCheckState.RETRY,
+                    f"Retrying after {req.state}: {req.failure_message}")
+            else:
+                self.driver.set_admission_check_state(
+                    key, self.check_name, AdmissionCheckState.REJECTED,
+                    f"{req.state}: {req.failure_message}")
+        # Pending/Accepted → leave the check Pending
+
+    def _set_ready(self, key: str, wl: Workload) -> None:
+        """Ready + PodSetUpdates (controller.go:659 podSetUpdates)."""
+        updates = []
+        if self.config.provisioning_class_name:
+            for ps in wl.pod_sets:
+                updates.append({
+                    "name": ps.name,
+                    "annotations": {
+                        "cluster-autoscaler.kubernetes.io/consume-provisioning-request":
+                            request_name(wl.name, self.check_name,
+                                         self._attempt(key)),
+                        "cluster-autoscaler.kubernetes.io/provisioning-class-name":
+                            self.config.provisioning_class_name,
+                    }})
+        st = wl.admission_check_states.get(self.check_name)
+        if st is not None:
+            st.pod_set_updates = updates
+        self.driver.set_admission_check_state(
+            key, self.check_name, AdmissionCheckState.READY, "Provisioned")

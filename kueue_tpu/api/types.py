@@ -503,6 +503,26 @@ class MultiKueueConfig:
     clusters: list[str] = field(default_factory=list)
 
 
+@dataclass
+class ProvisioningRequestRetryStrategy:
+    """reference provisioningrequestconfig_types.go retry strategy."""
+    backoff_limit_count: int = 3
+    backoff_base_seconds: int = 60
+    backoff_max_seconds: int = 1800
+
+
+@dataclass
+class ProvisioningRequestConfig:
+    """reference provisioningrequestconfig_types.go:119."""
+    name: str
+    provisioning_class_name: str = ""
+    parameters: dict[str, str] = field(default_factory=dict)
+    managed_resources: list[str] = field(default_factory=list)
+    retry_strategy: ProvisioningRequestRetryStrategy = field(
+        default_factory=ProvisioningRequestRetryStrategy)
+    pod_set_merge_policy: str = ""
+
+
 __all__ = [
     name for name, value in list(globals().items())
     if not name.startswith("_")

@@ -1,0 +1,216 @@
+"""Admission-check controller tests (reference
+test/integration/multikueue + admissionchecks/provisioning suites):
+multi-cluster dispatch with in-process worker Drivers, and the
+provisioning retry/backoff state machine."""
+
+import pytest
+
+from kueue_tpu.api.types import (
+    AdmissionCheck,
+    AdmissionCheckState,
+    ClusterQueue,
+    FlavorQuotas,
+    LocalQueue,
+    MultiKueueConfig,
+    PodSet,
+    ProvisioningRequestConfig,
+    ProvisioningRequestRetryStrategy,
+    ResourceFlavor,
+    ResourceGroup,
+    ResourceQuota,
+    Workload,
+)
+from kueue_tpu.admissionchecks import (
+    MultiKueueController,
+    ProvisioningController,
+    WorkerCluster,
+)
+from kueue_tpu.controller.driver import Driver
+
+
+class FakeClock:
+    def __init__(self, now=1000.0):
+        self.t = now
+
+    def __call__(self):
+        return self.t
+
+    def tick(self, dt=1.0):
+        self.t += dt
+
+
+def make_cluster(clock, nominal=5000, checks=()):
+    d = Driver(clock=clock)
+    d.apply_resource_flavor(ResourceFlavor(name="default"))
+    for c in checks:
+        d.apply_admission_check(AdmissionCheck(name=c))
+    d.apply_cluster_queue(ClusterQueue(
+        name="cq", admission_checks=list(checks),
+        resource_groups=[ResourceGroup(
+            covered_resources=["cpu"],
+            flavors=[FlavorQuotas(name="default", resources={
+                "cpu": ResourceQuota(nominal=nominal)})])]))
+    d.apply_local_queue(LocalQueue(name="lq", cluster_queue="cq"))
+    return d
+
+
+def wl(name, cpu=1000, created=1.0):
+    return Workload(name=name, queue_name="lq", creation_time=created,
+                    pod_sets=[PodSet(name="main", count=1,
+                                     requests={"cpu": cpu})])
+
+
+def multikueue_setup(worker_capacities=(5000, 5000)):
+    clock = FakeClock()
+    manager = make_cluster(clock, nominal=10_000, checks=("mk",))
+    clusters = {}
+    for i, cap in enumerate(worker_capacities):
+        clusters[f"worker-{i}"] = WorkerCluster(
+            name=f"worker-{i}", driver=make_cluster(clock, nominal=cap))
+    ctrl = MultiKueueController(
+        manager, check_name="mk",
+        config=MultiKueueConfig(name="mk-config",
+                                clusters=sorted(clusters)),
+        clusters=clusters, worker_lost_timeout=300.0)
+    return clock, manager, clusters, ctrl
+
+
+def pump(manager, clusters, ctrl, rounds=4):
+    for _ in range(rounds):
+        manager.run_until_settled()
+        ctrl.reconcile()
+        for c in clusters.values():
+            if c.active:
+                c.driver.run_until_settled()
+        ctrl.reconcile()
+
+
+def test_multikueue_dispatch_first_reservation_wins():
+    clock, manager, clusters, ctrl = multikueue_setup()
+    manager.create_workload(wl("job-a"))
+    pump(manager, clusters, ctrl)
+    mwl = manager.workloads["default/job-a"]
+    st = mwl.admission_check_states["mk"]
+    assert st.state == AdmissionCheckState.READY
+    assert mwl.is_admitted
+    # exactly one worker holds the mirror
+    holders = [n for n, c in clusters.items()
+               if "default/job-a" in c.driver.workloads]
+    assert len(holders) == 1
+    assert holders[0] in st.message
+
+
+def test_multikueue_remote_finish_propagates():
+    clock, manager, clusters, ctrl = multikueue_setup()
+    manager.create_workload(wl("job-b"))
+    pump(manager, clusters, ctrl)
+    holder = next(n for n, c in clusters.items()
+                  if "default/job-b" in c.driver.workloads)
+    clusters[holder].driver.finish_workload("default/job-b",
+                                            "Finished on worker")
+    pump(manager, clusters, ctrl)
+    assert manager.workloads["default/job-b"].is_finished
+
+
+def test_multikueue_worker_loss_ejects_and_redispatches():
+    clock, manager, clusters, ctrl = multikueue_setup()
+    manager.create_workload(wl("job-c"))
+    pump(manager, clusters, ctrl)
+    holder = next(n for n, c in clusters.items()
+                  if "default/job-c" in c.driver.workloads)
+    other = next(n for n in clusters if n != holder)
+    clusters[holder].mark_lost(clock())
+    clock.tick(301.0)
+    pump(manager, clusters, ctrl)
+    mwl = manager.workloads["default/job-c"]
+    # re-dispatched to the surviving worker after ejection+requeue
+    assert "default/job-c" in clusters[other].driver.workloads
+    assert mwl.admission_check_states["mk"].state == AdmissionCheckState.READY
+
+
+def test_multikueue_gc_removes_orphans():
+    clock, manager, clusters, ctrl = multikueue_setup()
+    manager.create_workload(wl("job-d"))
+    pump(manager, clusters, ctrl)
+    manager.delete_workload("default/job-d")
+    ctrl.reconcile()
+    ctrl.run_gc()
+    for c in clusters.values():
+        assert "default/job-d" not in c.driver.workloads
+
+
+# ---------------------------------------------------------------------------
+# Provisioning
+# ---------------------------------------------------------------------------
+
+def provisioning_setup(outcome="Provisioned", limit=2):
+    clock = FakeClock()
+    driver = make_cluster(clock, checks=("prov",))
+    outcomes = {"value": outcome}
+
+    def backend(req):
+        req.state = outcomes["value"]
+        if req.state != "Provisioned":
+            req.failure_message = "zone stockout"
+
+    ctrl = ProvisioningController(
+        driver, check_name="prov",
+        config=ProvisioningRequestConfig(
+            name="prov-config", provisioning_class_name="queued-provisioning",
+            retry_strategy=ProvisioningRequestRetryStrategy(
+                backoff_limit_count=limit, backoff_base_seconds=60)),
+        capacity_backend=backend)
+    return clock, driver, ctrl, outcomes
+
+
+def test_provisioning_success_sets_ready_with_podset_updates():
+    clock, driver, ctrl, _ = provisioning_setup()
+    driver.create_workload(wl("needs-nodes"))
+    driver.run_until_settled()
+    ctrl.reconcile()
+    mwl = driver.workloads["default/needs-nodes"]
+    st = mwl.admission_check_states["prov"]
+    assert st.state == AdmissionCheckState.READY
+    assert mwl.is_admitted
+    anns = st.pod_set_updates[0]["annotations"]
+    assert anns["cluster-autoscaler.kubernetes.io/provisioning-class-name"] \
+        == "queued-provisioning"
+
+
+def test_provisioning_failure_retries_with_backoff_then_rejects():
+    clock, driver, ctrl, outcomes = provisioning_setup(outcome="Failed",
+                                                       limit=2)
+    driver.create_workload(wl("doomed"))
+    driver.run_until_settled()
+    ctrl.reconcile()
+    mwl = driver.workloads["default/doomed"]
+    # first failure → Retry (evicted + requeued), attempt 2 scheduled
+    assert ctrl.retry_state["default/doomed"][0] == 2
+    # before the backoff expires nothing new happens
+    driver.run_until_settled()
+    ctrl.reconcile()
+    assert len([r for r in ctrl.requests.values()
+                if r.workload_key == "default/doomed" and r.attempt == 2]) == 0
+    clock.tick(61.0)
+    driver.run_until_settled()   # re-admission after requeue
+    ctrl.reconcile()
+    mwl = driver.workloads["default/doomed"]
+    # attempt 2 also failed and the limit is reached → Rejected+deactivated
+    assert not mwl.is_active
+    assert not mwl.is_admitted
+
+
+def test_provisioning_recovers_on_second_attempt():
+    clock, driver, ctrl, outcomes = provisioning_setup(outcome="Failed",
+                                                       limit=3)
+    driver.create_workload(wl("flaky"))
+    driver.run_until_settled()
+    ctrl.reconcile()
+    assert ctrl.retry_state["default/flaky"][0] == 2
+    outcomes["value"] = "Provisioned"
+    clock.tick(61.0)
+    driver.run_until_settled()
+    ctrl.reconcile()
+    mwl = driver.workloads["default/flaky"]
+    assert mwl.admission_check_states["prov"].state == AdmissionCheckState.READY
+    assert mwl.is_admitted
