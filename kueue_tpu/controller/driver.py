@@ -38,6 +38,7 @@ from ..cache.cache import Cache
 from ..queue.manager import Manager as QueueManager
 from ..queue.cluster_queue import RequeueReason
 from ..scheduler.scheduler import Scheduler
+from .. import webhooks
 from ..workload import (
     Info,
     InfoOptions,
@@ -72,7 +73,8 @@ class Driver:
                  info_options: InfoOptions | None = None,
                  wait_for_pods_ready: WaitForPodsReadyConfig | None = None,
                  namespaces: Optional[dict[str, dict[str, str]]] = None,
-                 use_device_solver: bool = False):
+                 use_device_solver: bool = False,
+                 validate: bool = True):
         self.clock = clock
         self.wait_for_pods_ready = wait_for_pods_ready or WaitForPodsReadyConfig()
         ordering = Ordering(
@@ -93,6 +95,7 @@ class Driver:
         # durable store: the CRD-status equivalent
         self.workloads: dict[str, Workload] = {}
         self.priority_classes: dict[str, object] = {}
+        self.validate = validate
         self.events: list[tuple[str, str, str]] = []  # (kind, key, note)
         self.metrics = metrics.Registry()
 
@@ -101,6 +104,8 @@ class Driver:
     # ------------------------------------------------------------------
 
     def apply_resource_flavor(self, flavor: ResourceFlavor) -> None:
+        if self.validate:
+            webhooks.validate_resource_flavor(flavor)
         self.cache.add_or_update_resource_flavor(flavor)
         self._wake_all()
 
@@ -120,6 +125,8 @@ class Driver:
         self._wake_all()
 
     def apply_cluster_queue(self, spec: ClusterQueue) -> None:
+        if self.validate:
+            webhooks.validate_cluster_queue(spec)
         self.cache.add_or_update_cluster_queue(spec)
         self.queues.add_cluster_queue(spec)
         self._sync_cq_activeness()
@@ -132,11 +139,15 @@ class Driver:
         self.queues.delete_cluster_queue(name)
 
     def apply_cohort(self, spec: Cohort) -> None:
+        if self.validate:
+            webhooks.validate_cohort(spec)
         self.cache.add_or_update_cohort(spec)
         self.queues.update_cohort_edge(spec.name, spec.parent_name)
         self._wake_all()
 
     def apply_local_queue(self, lq: LocalQueue) -> None:
+        if self.validate:
+            webhooks.validate_local_queue(lq)
         self.cache.add_or_update_local_queue(lq)
         self.queues.add_local_queue(lq)
 
@@ -155,6 +166,9 @@ class Driver:
     # ------------------------------------------------------------------
 
     def create_workload(self, wl: Workload) -> None:
+        webhooks.default_workload(wl)
+        if self.validate:
+            webhooks.validate_workload(wl)
         if wl.creation_time == 0.0:
             wl.creation_time = self.clock()
         self.workloads[wl.key] = wl

@@ -1,0 +1,95 @@
+"""Feature gates (reference pkg/features/kube_features.go:31-255).
+
+Versioned defaults mirroring the reference at its snapshot (≈ v0.11):
+each gate carries (default, stage, lock_to_default).  ``enabled(name)``
+is the runtime check; ``set_feature_gate_during_test`` is the test
+override (kube_features.go:257 SetFeatureGateDuringTest).
+"""
+
+from __future__ import annotations
+
+import contextlib
+from dataclasses import dataclass
+
+
+@dataclass(frozen=True)
+class FeatureSpec:
+    default: bool
+    stage: str                # Alpha | Beta | GA | Deprecated
+    lock_to_default: bool = False
+
+
+# Defaults as of the reference snapshot (kube_features.go:179-255, the
+# highest-version entry of each VersionedSpecs list).
+DEFAULT_FEATURE_GATES: dict[str, FeatureSpec] = {
+    "PartialAdmission": FeatureSpec(True, "Beta"),
+    "QueueVisibility": FeatureSpec(False, "Deprecated"),
+    "FlavorFungibility": FeatureSpec(True, "Beta"),
+    "ProvisioningACC": FeatureSpec(True, "Beta"),
+    "VisibilityOnDemand": FeatureSpec(True, "Beta"),
+    "PrioritySortingWithinCohort": FeatureSpec(True, "Beta"),
+    "MultiKueue": FeatureSpec(True, "Beta"),
+    "LendingLimit": FeatureSpec(True, "Beta"),
+    "MultiKueueBatchJobWithManagedBy": FeatureSpec(False, "Alpha"),
+    "MultiplePreemptions": FeatureSpec(True, "GA", lock_to_default=True),
+    "TopologyAwareScheduling": FeatureSpec(False, "Alpha"),
+    "ConfigurableResourceTransformations": FeatureSpec(True, "Beta"),
+    "WorkloadResourceRequestsSummary": FeatureSpec(True, "GA",
+                                                   lock_to_default=True),
+    "ExposeFlavorsInLocalQueue": FeatureSpec(True, "Beta"),
+    "AdmissionCheckValidationRules": FeatureSpec(False, "Deprecated"),
+    "KeepQuotaForProvReqRetry": FeatureSpec(False, "Deprecated"),
+    "ManagedJobsNamespaceSelector": FeatureSpec(True, "Beta"),
+    "LocalQueueMetrics": FeatureSpec(False, "Alpha"),
+    "LocalQueueDefaulting": FeatureSpec(False, "Alpha"),
+    "TASProfileMostFreeCapacity": FeatureSpec(False, "Alpha"),
+    "TASProfileLeastFreeCapacity": FeatureSpec(False, "Alpha"),
+    "TASProfileMixed": FeatureSpec(False, "Alpha"),
+}
+
+_overrides: dict[str, bool] = {}
+
+
+class UnknownFeatureError(KeyError):
+    pass
+
+
+def enabled(name: str) -> bool:
+    if name in _overrides:
+        return _overrides[name]
+    spec = DEFAULT_FEATURE_GATES.get(name)
+    if spec is None:
+        raise UnknownFeatureError(name)
+    return spec.default
+
+
+def set_feature_gates(gates: dict[str, bool]) -> None:
+    """Apply --feature-gates style overrides (cmd/kueue/main.go:129-144)."""
+    for name, value in gates.items():
+        spec = DEFAULT_FEATURE_GATES.get(name)
+        if spec is None:
+            raise UnknownFeatureError(name)
+        if spec.lock_to_default and value != spec.default:
+            raise ValueError(
+                f"cannot set feature gate {name} to {value}: locked to "
+                f"{spec.default} ({spec.stage})")
+        _overrides[name] = value
+
+
+def reset_feature_gates() -> None:
+    _overrides.clear()
+
+
+@contextlib.contextmanager
+def set_feature_gate_during_test(name: str, value: bool):
+    """reference kube_features.go:257."""
+    had = name in _overrides
+    prev = _overrides.get(name)
+    set_feature_gates({name: value})
+    try:
+        yield
+    finally:
+        if had:
+            _overrides[name] = prev
+        else:
+            _overrides.pop(name, None)

@@ -1,0 +1,23 @@
+"""Webhook-equivalent defaulting and validation (reference pkg/webhooks).
+
+Every write into the driver passes through these validators, mirroring
+the reference's admission webhooks: workload_webhook.go,
+clusterqueue_webhook.go, cohort_webhook.go, resourceflavor_webhook.go.
+"""
+
+from .validation import (
+    ValidationError,
+    default_workload,
+    validate_cluster_queue,
+    validate_cohort,
+    validate_local_queue,
+    validate_resource_flavor,
+    validate_workload,
+    validate_workload_update,
+)
+
+__all__ = [
+    "ValidationError", "default_workload", "validate_cluster_queue",
+    "validate_cohort", "validate_local_queue", "validate_resource_flavor",
+    "validate_workload", "validate_workload_update",
+]

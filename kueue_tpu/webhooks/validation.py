@@ -1,0 +1,210 @@
+"""Validators mirroring reference pkg/webhooks rules."""
+
+from __future__ import annotations
+
+import re
+from typing import Optional
+
+from ..api.types import (
+    BorrowWithinCohortPolicy,
+    ClusterQueue,
+    Cohort,
+    LocalQueue,
+    ReclaimWithinCohort,
+    ResourceFlavor,
+    Workload,
+)
+
+_DNS1123 = re.compile(r"^[a-z0-9]([-a-z0-9]*[a-z0-9])?$")
+MAX_PODSETS = 8
+
+
+class ValidationError(ValueError):
+    def __init__(self, errors: list[str]):
+        super().__init__("; ".join(errors))
+        self.errors = errors
+
+
+def _check(errors: list[str]) -> None:
+    if errors:
+        raise ValidationError(errors)
+
+
+def _valid_name(name: str) -> bool:
+    return bool(name) and len(name) <= 253 and bool(_DNS1123.match(name))
+
+
+# ---------------------------------------------------------------------------
+# Workload (workload_webhook.go)
+# ---------------------------------------------------------------------------
+
+def default_workload(wl: Workload) -> None:
+    """Defaulting (workload_webhook.go Default): single unnamed pod set
+    becomes "main"."""
+    if len(wl.pod_sets) == 1 and not wl.pod_sets[0].name:
+        wl.pod_sets[0].name = "main"
+
+
+def validate_workload(wl: Workload) -> None:
+    errors: list[str] = []
+    if not _valid_name(wl.name):
+        errors.append(f"metadata.name: invalid name {wl.name!r}")
+    if not wl.pod_sets:
+        errors.append("spec.podSets: at least one pod set is required")
+    if len(wl.pod_sets) > MAX_PODSETS:
+        errors.append(f"spec.podSets: at most {MAX_PODSETS} pod sets")
+    seen = set()
+    variable_count = 0
+    for i, ps in enumerate(wl.pod_sets):
+        path = f"spec.podSets[{i}]"
+        if not _valid_name(ps.name):
+            errors.append(f"{path}.name: invalid name {ps.name!r}")
+        if ps.name in seen:
+            errors.append(f"{path}.name: duplicate pod set name {ps.name!r}")
+        seen.add(ps.name)
+        if ps.count < 0:
+            errors.append(f"{path}.count: must be >= 0")
+        if ps.min_count is not None:
+            variable_count += 1
+            if not 0 < ps.min_count <= ps.count:
+                errors.append(f"{path}.minCount: must be in (0, count]")
+        for res, v in ps.requests.items():
+            if v < 0:
+                errors.append(f"{path}.requests[{res}]: must be >= 0")
+    if variable_count > 1:
+        # workload_webhook.go:110
+        errors.append("spec.podSets: at most one podSet can use minCount")
+
+    if wl.admission is not None:
+        ps_names = {ps.name for ps in wl.pod_sets}
+        asg_names = {a.name for a in wl.admission.pod_set_assignments}
+        if asg_names != ps_names:
+            errors.append(
+                "status.admission: podSetAssignments must match spec.podSets")
+    for rp in wl.reclaimable_pods:
+        counts = {ps.name: ps.count for ps in wl.pod_sets}
+        if rp.name not in counts:
+            errors.append(
+                f"status.reclaimablePods[{rp.name}]: unknown pod set")
+        elif rp.count > counts[rp.name]:
+            errors.append(
+                f"status.reclaimablePods[{rp.name}]: count exceeds pod set")
+    _check(errors)
+
+
+def validate_workload_update(new: Workload, old: Workload) -> None:
+    """workload_webhook.go:268 ValidateWorkloadUpdate."""
+    validate_workload(new)
+    errors: list[str] = []
+    if old.has_quota_reservation:
+        old_ps = [(p.name, p.count, dict(p.requests)) for p in old.pod_sets]
+        new_ps = [(p.name, p.count, dict(p.requests)) for p in new.pod_sets]
+        if old_ps != new_ps:
+            errors.append("spec.podSets: immutable while quota is reserved")
+    if old.has_quota_reservation and new.has_quota_reservation:
+        old_counts = {rp.name: rp.count for rp in old.reclaimable_pods}
+        for rp in new.reclaimable_pods:
+            if rp.count < old_counts.get(rp.name, 0):
+                errors.append(
+                    f"status.reclaimablePods[{rp.name}]: cannot decrease "
+                    "while admitted")
+    if (new.admission is not None and old.admission is not None
+            and new.admission != old.admission):
+        errors.append("status.admission: immutable once set (unset first)")
+    _check(errors)
+
+
+# ---------------------------------------------------------------------------
+# ClusterQueue (clusterqueue_webhook.go)
+# ---------------------------------------------------------------------------
+
+def validate_cluster_queue(cq: ClusterQueue,
+                           lending_limit_enabled: bool = True) -> None:
+    errors: list[str] = []
+    if not _valid_name(cq.name):
+        errors.append(f"metadata.name: invalid name {cq.name!r}")
+    if cq.cohort and not _valid_name(cq.cohort):
+        errors.append(f"spec.cohort: invalid name {cq.cohort!r}")
+    if cq.admission_checks and cq.admission_checks_strategy:
+        # clusterqueue_webhook.go:132
+        errors.append("spec: either admissionChecks or "
+                      "admissionChecksStrategy can be set, but not both")
+    p = cq.preemption
+    if (p is not None
+            and p.reclaim_within_cohort == ReclaimWithinCohort.NEVER
+            and p.borrow_within_cohort is not None
+            and p.borrow_within_cohort.policy != BorrowWithinCohortPolicy.NEVER):
+        # clusterqueue_webhook.go:124
+        errors.append("spec.preemption: reclaimWithinCohort=Never and "
+                      "borrowWithinCohort.Policy!=Never")
+    seen_flavors: set[str] = set()
+    for gi, rg in enumerate(cq.resource_groups):
+        path = f"spec.resourceGroups[{gi}]"
+        if not rg.covered_resources:
+            errors.append(f"{path}.coveredResources: required")
+        if not rg.flavors:
+            errors.append(f"{path}.flavors: required")
+        for fi, fq in enumerate(rg.flavors):
+            fpath = f"{path}.flavors[{fi}]"
+            if fq.name in seen_flavors:
+                errors.append(f"{fpath}.name: duplicate flavor {fq.name!r}")
+            seen_flavors.add(fq.name)
+            if set(fq.resources) != set(rg.covered_resources):
+                # clusterqueue_webhook.go:176
+                errors.append(f"{fpath}.resources: must match the names in "
+                              "coveredResources")
+            for res, q in fq.resources.items():
+                rpath = f"{fpath}.resources[{res}]"
+                if q.nominal < 0:
+                    errors.append(f"{rpath}.nominalQuota: must be >= 0")
+                for limit_name, limit in (
+                        ("borrowingLimit", q.borrowing_limit),
+                        ("lendingLimit", q.lending_limit)):
+                    if limit is None:
+                        continue
+                    if limit < 0:
+                        errors.append(f"{rpath}.{limit_name}: must be >= 0")
+                    if not cq.cohort:
+                        # clusterqueue_webhook.go:204 validateLimit
+                        errors.append(f"{rpath}.{limit_name}: must be nil "
+                                      "when cohort is empty")
+                if (q.lending_limit is not None and lending_limit_enabled
+                        and q.lending_limit > q.nominal):
+                    # clusterqueue_webhook.go:213
+                    errors.append(f"{rpath}.lendingLimit: must be less than "
+                                  "or equal to the nominalQuota")
+    _check(errors)
+
+
+# ---------------------------------------------------------------------------
+# Cohort / ResourceFlavor / LocalQueue
+# ---------------------------------------------------------------------------
+
+def validate_cohort(cohort: Cohort) -> None:
+    errors: list[str] = []
+    if not _valid_name(cohort.name):
+        errors.append(f"metadata.name: invalid name {cohort.name!r}")
+    if cohort.parent_name and not _valid_name(cohort.parent_name):
+        errors.append(f"spec.parentName: invalid name {cohort.parent_name!r}")
+    if cohort.parent_name == cohort.name:
+        errors.append("spec.parentName: cohort cannot be its own parent")
+    _check(errors)
+
+
+def validate_resource_flavor(flavor: ResourceFlavor) -> None:
+    errors: list[str] = []
+    if not _valid_name(flavor.name):
+        errors.append(f"metadata.name: invalid name {flavor.name!r}")
+    for k in flavor.node_labels:
+        if not k or len(k) > 317:
+            errors.append(f"spec.nodeLabels: invalid key {k!r}")
+    _check(errors)
+
+
+def validate_local_queue(lq: LocalQueue) -> None:
+    errors: list[str] = []
+    if not _valid_name(lq.name):
+        errors.append(f"metadata.name: invalid name {lq.name!r}")
+    if not _valid_name(lq.cluster_queue):
+        errors.append(f"spec.clusterQueue: invalid name {lq.cluster_queue!r}")
+    _check(errors)

@@ -1,0 +1,202 @@
+"""Configuration load/validate/default, feature gates, and webhook
+validation tests (reference pkg/config + pkg/webhooks + pkg/features)."""
+
+import pytest
+
+from kueue_tpu import features
+from kueue_tpu.api.types import (
+    BorrowWithinCohort,
+    BorrowWithinCohortPolicy,
+    ClusterQueue,
+    Cohort,
+    FlavorQuotas,
+    LocalQueue,
+    PodSet,
+    PreemptionPolicy,
+    ReclaimWithinCohort,
+    ResourceGroup,
+    ResourceQuota,
+    Workload,
+)
+from kueue_tpu.config import (
+    ConfigValidationError,
+    default_configuration,
+    load,
+    validate,
+)
+from kueue_tpu.webhooks import (
+    ValidationError,
+    default_workload,
+    validate_cluster_queue,
+    validate_cohort,
+    validate_workload,
+    validate_workload_update,
+)
+
+
+# -- config -----------------------------------------------------------------
+
+def test_config_defaults():
+    cfg = default_configuration()
+    assert cfg.namespace == "kueue-system"
+    assert cfg.integrations.frameworks == ["batch/job"]
+    assert not cfg.fair_sharing.enable
+    assert cfg.multikueue.worker_lost_timeout_seconds == 900.0
+    assert validate(cfg) == []
+
+
+def test_config_load_yaml(tmp_path):
+    p = tmp_path / "config.yaml"
+    p.write_text("""
+apiVersion: config.kueue.x-k8s.io/v1beta1
+kind: Configuration
+namespace: my-system
+waitForPodsReady:
+  enable: true
+  timeout: 10m
+  requeuingStrategy:
+    timestamp: Creation
+    backoffLimitCount: 5
+integrations:
+  frameworks:
+    - batch/job
+    - jobset.x-k8s.io/jobset
+    - kubeflow.org/pytorchjob
+fairSharing:
+  enable: true
+  preemptionStrategies: [LessThanOrEqualToFinalShare]
+resources:
+  excludeResourcePrefixes: ["networking.example.com/"]
+  transformations:
+    - input: nvidia.com/mig-1g.5gb
+      strategy: Replace
+      outputs:
+        example.com/accelerator-memory: 5
+multiKueue:
+  gcInterval: 30s
+  workerLostTimeout: 10m
+featureGates:
+  TopologyAwareScheduling: true
+""")
+    cfg = load(str(p))
+    assert cfg.namespace == "my-system"
+    assert cfg.wait_for_pods_ready.enable
+    assert cfg.wait_for_pods_ready.timeout_seconds == 600.0
+    assert cfg.wait_for_pods_ready.requeuing_strategy.timestamp == "Creation"
+    assert "kubeflow.org/pytorchjob" in cfg.integrations.frameworks
+    assert cfg.fair_sharing.enable
+    assert cfg.resources.transformations[0].outputs == {
+        "example.com/accelerator-memory": 5}
+    assert cfg.multikueue.worker_lost_timeout_seconds == 600.0
+    assert cfg.feature_gates == {"TopologyAwareScheduling": True}
+
+
+def test_config_invalid_rejected(tmp_path):
+    p = tmp_path / "bad.yaml"
+    p.write_text("""
+integrations:
+  frameworks: [not-a-framework]
+featureGates:
+  NotAGate: true
+""")
+    with pytest.raises(ConfigValidationError) as e:
+        load(str(p))
+    assert any("not-a-framework" in m for m in e.value.errors)
+    assert any("NotAGate" in m for m in e.value.errors)
+
+
+# -- features ---------------------------------------------------------------
+
+def test_feature_gate_defaults_and_overrides():
+    assert features.enabled("PartialAdmission")
+    assert not features.enabled("TopologyAwareScheduling")
+    with features.set_feature_gate_during_test("TopologyAwareScheduling", True):
+        assert features.enabled("TopologyAwareScheduling")
+    assert not features.enabled("TopologyAwareScheduling")
+    with pytest.raises(features.UnknownFeatureError):
+        features.enabled("Bogus")
+    # GA-locked gates cannot be flipped (MultiplePreemptions)
+    with pytest.raises(ValueError):
+        features.set_feature_gates({"MultiplePreemptions": False})
+
+
+# -- webhooks ---------------------------------------------------------------
+
+def cq(name="cq", cohort=None, **q):
+    quota = ResourceQuota(nominal=q.pop("nominal", 1000), **q)
+    return ClusterQueue(name=name, cohort=cohort,
+                        resource_groups=[ResourceGroup(
+                            covered_resources=["cpu"],
+                            flavors=[FlavorQuotas(name="default",
+                                                  resources={"cpu": quota})])])
+
+
+def test_cq_limits_require_cohort():
+    with pytest.raises(ValidationError, match="must be nil when cohort"):
+        validate_cluster_queue(cq(borrowing_limit=500))
+    validate_cluster_queue(cq(cohort="team", borrowing_limit=500))
+    with pytest.raises(ValidationError, match="must be nil when cohort"):
+        validate_cluster_queue(cq(lending_limit=500))
+
+
+def test_cq_lending_limit_le_nominal():
+    with pytest.raises(ValidationError, match="lendingLimit"):
+        validate_cluster_queue(cq(cohort="team", nominal=1000,
+                                  lending_limit=2000))
+
+
+def test_cq_preemption_policy_combo():
+    bad = cq()
+    bad.preemption = PreemptionPolicy(
+        reclaim_within_cohort=ReclaimWithinCohort.NEVER,
+        borrow_within_cohort=BorrowWithinCohort(
+            policy=BorrowWithinCohortPolicy.LOWER_PRIORITY))
+    with pytest.raises(ValidationError, match="reclaimWithinCohort"):
+        validate_cluster_queue(bad)
+
+
+def test_cq_flavor_resources_must_match_covered():
+    bad = ClusterQueue(name="cq", resource_groups=[ResourceGroup(
+        covered_resources=["cpu", "memory"],
+        flavors=[FlavorQuotas(name="default",
+                              resources={"cpu": ResourceQuota(nominal=1)})])])
+    with pytest.raises(ValidationError, match="coveredResources"):
+        validate_cluster_queue(bad)
+
+
+def test_cohort_self_parent():
+    with pytest.raises(ValidationError, match="own parent"):
+        validate_cohort(Cohort(name="a", parent_name="a"))
+
+
+def test_workload_validation():
+    with pytest.raises(ValidationError, match="at least one pod set"):
+        validate_workload(Workload(name="w"))
+    too_many = Workload(name="w", pod_sets=[
+        PodSet(name=f"ps{i}", count=1) for i in range(9)])
+    with pytest.raises(ValidationError, match="at most 8"):
+        validate_workload(too_many)
+    two_min = Workload(name="w", pod_sets=[
+        PodSet(name="a", count=2, min_count=1),
+        PodSet(name="b", count=2, min_count=1)])
+    with pytest.raises(ValidationError, match="at most one podSet"):
+        validate_workload(two_min)
+    wl = Workload(name="w", pod_sets=[PodSet(name="", count=1)])
+    default_workload(wl)
+    assert wl.pod_sets[0].name == "main"
+    validate_workload(wl)
+
+
+def test_workload_update_immutability():
+    from kueue_tpu.api.types import (Admission, Condition, ConditionStatus,
+                                     PodSetAssignment, WL_QUOTA_RESERVED)
+    old = Workload(name="w", pod_sets=[PodSet(name="main", count=2,
+                                              requests={"cpu": 100})])
+    old.admission = Admission(cluster_queue="cq", pod_set_assignments=[
+        PodSetAssignment(name="main", count=2)])
+    old.set_condition(WL_QUOTA_RESERVED, ConditionStatus.TRUE, "r", "m", 1.0)
+    new = old.clone()
+    new.pod_sets[0].count = 3
+    new.admission.pod_set_assignments[0].count = 3
+    with pytest.raises(ValidationError, match="immutable"):
+        validate_workload_update(new, old)

@@ -103,6 +103,9 @@ def build_driver(seed, use_device_solver, n_cqs=4, n_wl=40):
     d.apply_resource_flavor(ResourceFlavor(name="f1"))
     for i in range(n_cqs):
         cohort = ["team-a", "team-b", None][i % 3]
+        # borrowingLimit requires a cohort (webhook: "must be nil when
+        # cohort is empty")
+        blimit = 2000 if cohort is not None else None
         d.apply_cluster_queue(ClusterQueue(
             name=f"cq-{i}", cohort=cohort,
             resource_groups=[ResourceGroup(
@@ -113,7 +116,7 @@ def build_driver(seed, use_device_solver, n_cqs=4, n_wl=40):
                         "memory": ResourceQuota(nominal=8 * 2**30)}),
                     FlavorQuotas(name="f1", resources={
                         "cpu": ResourceQuota(nominal=8000,
-                                             borrowing_limit=2000),
+                                             borrowing_limit=blimit),
                         "memory": ResourceQuota(nominal=16 * 2**30)}),
                 ])]))
         d.apply_local_queue(LocalQueue(name=f"lq-{i}", cluster_queue=f"cq-{i}"))
