@@ -175,6 +175,20 @@ class Driver:
         self.queues.add_or_update_workload(wl)
         self.metrics.pending_inc(wl)
 
+    def restore_workload(self, wl: Workload) -> None:
+        """Crash-recovery replay (SURVEY §5.4): rebuild in-memory state
+        from a stored workload — admitted usage goes back into the cache,
+        pending workloads back into the queues, like the CRD watch replay
+        on reference manager restart."""
+        self.workloads[wl.key] = wl
+        if wl.is_finished or not wl.is_active:
+            return
+        if wl.admission is not None and wl.has_quota_reservation:
+            info = Info(wl, self.cache.info_options)
+            self.cache.add_or_update_workload(info)
+        else:
+            self.queues.add_or_update_workload(wl)
+
     def delete_workload(self, key: str) -> None:
         wl = self.workloads.pop(key, None)
         if wl is None:

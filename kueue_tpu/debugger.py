@@ -1,0 +1,44 @@
+"""State dump on signal (reference pkg/debugger: SIGUSR2 → dump queue
+heads + cache usage to logs; queue/dumper.go)."""
+
+from __future__ import annotations
+
+import signal
+import sys
+from typing import Optional, TextIO
+
+
+def dump_state(driver, out: Optional[TextIO] = None) -> str:
+    """Render the queues + cache state (debugger.go:33 + dumper.go)."""
+    lines = []
+    lines.append("=== kueue-tpu state dump ===")
+    lines.append("-- pending queues --")
+    for name in sorted(driver.cache.cluster_queue_names()):
+        infos = driver.queues.pending_workloads_info(name)
+        heads = ", ".join(i.obj.name for i in infos[:5])
+        lines.append(f"  {name}: {len(infos)} pending"
+                     + (f" (head: {heads})" if heads else ""))
+    lines.append("-- cache usage --")
+    for name in sorted(driver.cache.cluster_queue_names()):
+        usage = driver.cache.usage(name)
+        used = {f"{fr.flavor}/{fr.resource}": v
+                for fr, v in sorted(usage.items()) if v}
+        lines.append(f"  {name}: {used if used else '{}'}")
+    lines.append("-- admitted workloads --")
+    for key in sorted(driver.admitted_keys()):
+        lines.append(f"  {key}")
+    text = "\n".join(lines)
+    if out is not None:
+        print(text, file=out)
+    return text
+
+
+class Dumper:
+    """reference debugger.NewDumper(...).ListenForSignal."""
+
+    def __init__(self, driver, out: Optional[TextIO] = None):
+        self.driver = driver
+        self.out = out or sys.stderr
+
+    def listen_for_signal(self, signum: int = signal.SIGUSR2) -> None:
+        signal.signal(signum, lambda s, f: dump_state(self.driver, self.out))

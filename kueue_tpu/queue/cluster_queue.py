@@ -157,8 +157,10 @@ class ClusterQueueQueue:
         return self.pending_active() + self.pending_inadmissible()
 
     def snapshot_sorted(self) -> list[Info]:
-        """Heap contents in order, for visibility APIs."""
-        items = self.heap.items()
+        """Active heap + inadmissible parking lot in queue order, for
+        visibility APIs (reference cluster_queue.go Snapshot includes
+        inadmissibleWorkloads)."""
+        items = self.heap.items() + list(self.inadmissible.values())
         less = queue_ordering_less(self.ordering)
         import functools
         return sorted(items, key=functools.cmp_to_key(

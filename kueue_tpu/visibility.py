@@ -1,0 +1,162 @@
+"""On-demand visibility API (reference pkg/visibility + apis/visibility).
+
+The reference embeds an aggregated API server (server.go:62) serving live
+pending-workload summaries straight from the queue manager
+(api/v1beta1/pending_workloads_cq.go / _lq.go).  Here the same data is
+exposed two ways: typed accessors (``VisibilityService``) and a real HTTP
+endpoint (``serve``) speaking the reference's REST shape — which also
+doubles as the kueueviz dashboard feed (cmd/kueueviz backend).
+"""
+
+from __future__ import annotations
+
+import json
+import threading
+from dataclasses import asdict, dataclass, field
+from typing import Optional
+
+
+@dataclass
+class PendingWorkload:
+    """reference apis/visibility/v1beta1/types.go:64."""
+    name: str
+    namespace: str
+    local_queue_name: str
+    priority: int
+    position_in_cluster_queue: int
+    position_in_local_queue: int
+
+
+@dataclass
+class PendingWorkloadsSummary:
+    """reference apis/visibility/v1beta1/types.go:85."""
+    items: list[PendingWorkload] = field(default_factory=list)
+
+
+class VisibilityService:
+    """reference visibility/api/v1beta1 REST storage."""
+
+    def __init__(self, driver):
+        self.driver = driver
+
+    def pending_workloads_cq(self, cq_name: str, limit: Optional[int] = None,
+                             offset: int = 0) -> PendingWorkloadsSummary:
+        """GET .../clusterqueues/{cq}/pendingworkloads
+        (pending_workloads_cq.go)."""
+        infos = self.driver.queues.pending_workloads_info(cq_name)
+        lq_positions: dict[str, int] = {}
+        items = []
+        for pos, info in enumerate(infos):
+            wl = info.obj
+            lq = f"{wl.namespace}/{wl.queue_name}"
+            lq_pos = lq_positions.get(lq, 0)
+            lq_positions[lq] = lq_pos + 1
+            if pos < offset:
+                continue
+            if limit is not None and len(items) >= limit:
+                continue
+            items.append(PendingWorkload(
+                name=wl.name, namespace=wl.namespace,
+                local_queue_name=wl.queue_name, priority=wl.priority,
+                position_in_cluster_queue=pos,
+                position_in_local_queue=lq_pos))
+        return PendingWorkloadsSummary(items=items)
+
+    def pending_workloads_lq(self, namespace: str, lq_name: str,
+                             limit: Optional[int] = None,
+                             offset: int = 0) -> PendingWorkloadsSummary:
+        """GET .../localqueues/{lq}/pendingworkloads
+        (pending_workloads_lq.go)."""
+        lq = self.driver.queues.local_queues.get(f"{namespace}/{lq_name}")
+        if lq is None:
+            return PendingWorkloadsSummary()
+        cq_summary = self.pending_workloads_cq(lq.cluster_queue)
+        items = [w for w in cq_summary.items
+                 if w.namespace == namespace and w.local_queue_name == lq_name]
+        items = items[offset:]
+        if limit is not None:
+            items = items[:limit]
+        return PendingWorkloadsSummary(items=items)
+
+    # -- dashboard feed (kueueviz-equivalent aggregates) ---------------
+
+    def cluster_queues_summary(self) -> dict:
+        out = {}
+        for name in self.driver.cache.cluster_queue_names():
+            cq = self.driver.cache.cluster_queue(name)
+            if cq is None:
+                continue
+            out[name] = {
+                "active": cq.active,
+                "pending": self.driver.queues.pending_workloads(name),
+                "usage": {f"{fr.flavor}/{fr.resource}": v
+                          for fr, v in sorted(
+                              self.driver.cache.usage(name).items())},
+            }
+        return out
+
+
+class VisibilityServer:
+    """The aggregated-API-server equivalent: a real HTTP endpoint
+    (reference visibility/server.go:62 + kueueviz backend)."""
+
+    def __init__(self, driver, host: str = "127.0.0.1", port: int = 0):
+        self.service = VisibilityService(driver)
+        self.host = host
+        self.port = port
+        self._httpd = None
+        self._thread = None
+
+    def start(self) -> int:
+        from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+
+        service = self.service
+
+        class Handler(BaseHTTPRequestHandler):
+            def log_message(self, *a):  # quiet
+                pass
+
+            def do_GET(self):
+                parts = [p for p in self.path.split("?")[0].split("/") if p]
+                # /apis/visibility/v1beta1/clusterqueues/{cq}/pendingworkloads
+                # /apis/visibility/v1beta1/namespaces/{ns}/localqueues/{lq}/pendingworkloads
+                # /apis/visibility/v1beta1/clusterqueues
+                try:
+                    if parts[:3] != ["apis", "visibility", "v1beta1"]:
+                        raise KeyError(self.path)
+                    rest = parts[3:]
+                    if rest == ["clusterqueues"]:
+                        body = service.cluster_queues_summary()
+                    elif (len(rest) == 3 and rest[0] == "clusterqueues"
+                          and rest[2] == "pendingworkloads"):
+                        body = asdict(service.pending_workloads_cq(rest[1]))
+                    elif (len(rest) == 5 and rest[0] == "namespaces"
+                          and rest[2] == "localqueues"
+                          and rest[4] == "pendingworkloads"):
+                        body = asdict(
+                            service.pending_workloads_lq(rest[1], rest[3]))
+                    else:
+                        raise KeyError(self.path)
+                except (KeyError, IndexError):
+                    self.send_response(404)
+                    self.end_headers()
+                    return
+                payload = json.dumps(body).encode()
+                self.send_response(200)
+                self.send_header("Content-Type", "application/json")
+                self.send_header("Content-Length", str(len(payload)))
+                self.end_headers()
+                self.wfile.write(payload)
+
+        self._httpd = ThreadingHTTPServer((self.host, self.port), Handler)
+        self.port = self._httpd.server_address[1]
+        self._thread = threading.Thread(target=self._httpd.serve_forever,
+                                        daemon=True)
+        self._thread.start()
+        return self.port
+
+    def stop(self) -> None:
+        if self._httpd is not None:
+            self._httpd.shutdown()
+            self._httpd.server_close()
+            self._httpd = None

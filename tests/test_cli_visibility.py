@@ -1,0 +1,247 @@
+"""CLI, manifest codec, visibility API, and debugger tests (reference
+cmd/kueuectl, cmd/importer, pkg/visibility, pkg/debugger)."""
+
+import io
+import json
+import urllib.request
+
+import pytest
+
+from kueue_tpu.api.manifests import from_manifest, load_manifests, to_manifest
+from kueue_tpu.api.types import (
+    ClusterQueue,
+    FlavorQuotas,
+    LocalQueue,
+    PodSet,
+    ResourceFlavor,
+    ResourceGroup,
+    ResourceQuota,
+    Workload,
+)
+from kueue_tpu.cli import Store, build_driver, main, save_workloads
+from kueue_tpu.controller.driver import Driver
+from kueue_tpu.debugger import dump_state
+from kueue_tpu.visibility import VisibilityServer, VisibilityService
+
+SETUP_YAML = """
+apiVersion: kueue.x-k8s.io/v1beta1
+kind: ResourceFlavor
+metadata:
+  name: default-flavor
+---
+apiVersion: kueue.x-k8s.io/v1beta1
+kind: ClusterQueue
+metadata:
+  name: cluster-queue
+spec:
+  namespaceSelector: {}
+  resourceGroups:
+  - coveredResources: ["cpu", "memory"]
+    flavors:
+    - name: "default-flavor"
+      resources:
+      - name: "cpu"
+        nominalQuota: 9
+      - name: "memory"
+        nominalQuota: 36Gi
+---
+apiVersion: kueue.x-k8s.io/v1beta1
+kind: LocalQueue
+metadata:
+  namespace: default
+  name: user-queue
+spec:
+  clusterQueue: cluster-queue
+"""
+
+WORKLOAD_YAML = """
+apiVersion: kueue.x-k8s.io/v1beta1
+kind: Workload
+metadata:
+  name: job-{i}
+  namespace: default
+spec:
+  queueName: user-queue
+  podSets:
+  - name: main
+    count: 1
+    template:
+      spec:
+        containers:
+        - name: c
+          resources:
+            requests:
+              cpu: "2"
+              memory: 4Gi
+"""
+
+
+def test_manifest_codec_reference_setup():
+    """The reference's examples/admin/single-clusterqueue-setup.yaml shape
+    parses to our API model."""
+    objs = load_manifests(SETUP_YAML)
+    flavor, cq, lq = objs
+    assert isinstance(flavor, ResourceFlavor)
+    assert isinstance(cq, ClusterQueue)
+    assert cq.namespace_selector == {}          # match-all
+    q = cq.resource_groups[0].flavors[0].resources
+    assert q["cpu"].nominal == 9000             # milli
+    assert q["memory"].nominal == 36 * 2**30    # bytes
+    assert isinstance(lq, LocalQueue)
+    assert lq.cluster_queue == "cluster-queue"
+
+
+def test_workload_manifest_roundtrip():
+    wl = load_manifests(WORKLOAD_YAML.format(i=1))[0]
+    assert wl.pod_sets[0].requests == {"cpu": 2000, "memory": 4 * 2**30}
+    doc = to_manifest(wl)
+    wl2 = from_manifest(doc)
+    assert wl2.pod_sets[0].requests == wl.pod_sets[0].requests
+    assert wl2.queue_name == wl.queue_name
+
+
+def run_cli(tmp_path, *argv):
+    return main(["--state-dir", str(tmp_path)] + list(argv))
+
+
+def test_cli_end_to_end(tmp_path, capsys):
+    setup = tmp_path / "setup.yaml"
+    setup.write_text(SETUP_YAML)
+    assert run_cli(tmp_path, "apply", "-f", str(setup)) == 0
+    jobs = tmp_path / "jobs.yaml"
+    jobs.write_text("\n---\n".join(WORKLOAD_YAML.format(i=i)
+                                   for i in range(6)))
+    assert run_cli(tmp_path, "apply", "-f", str(jobs)) == 0
+    assert run_cli(tmp_path, "schedule") == 0
+    out = capsys.readouterr().out
+    # 9 CPUs / 2 per job → 4 admitted
+    assert "admitted 4 workloads" in out
+    assert run_cli(tmp_path, "list", "workload") == 0
+    out = capsys.readouterr().out
+    assert out.count("Admitted") == 4
+    assert out.count("Pending") == 2
+
+    # restart from disk: replay keeps prior admissions (checkpoint/resume)
+    store = Store(str(tmp_path))
+    driver = build_driver(store)
+    assert len(driver.admitted_keys()) == 4
+
+    # finishing via delete frees quota; next schedule admits the rest
+    assert run_cli(tmp_path, "delete", "workload", "job-0") == 0
+    assert run_cli(tmp_path, "delete", "workload", "job-1") == 0
+    capsys.readouterr()
+    assert run_cli(tmp_path, "schedule") == 0
+    assert "admitted 4 workloads" in capsys.readouterr().out
+
+
+def test_cli_create_and_stop_resume(tmp_path, capsys):
+    assert run_cli(tmp_path, "create", "resourceflavor", "default",
+                   "--node-labels", "zone=a") == 0
+    assert run_cli(tmp_path, "create", "clusterqueue", "cq",
+                   "--nominal-quota", "cpu=10") == 0
+    assert run_cli(tmp_path, "create", "localqueue", "lq",
+                   "--clusterqueue", "cq") == 0
+    assert run_cli(tmp_path, "stop", "clusterqueue", "cq") == 0
+    store = Store(str(tmp_path))
+    assert store.get("ClusterQueue", "cq")["spec"]["stopPolicy"] == \
+        "HoldAndDrain"
+    assert run_cli(tmp_path, "resume", "clusterqueue", "cq") == 0
+    store = Store(str(tmp_path))
+    assert store.get("ClusterQueue", "cq")["spec"]["stopPolicy"] == "None"
+
+
+def test_cli_import_pods(tmp_path, capsys):
+    setup = tmp_path / "setup.yaml"
+    setup.write_text(SETUP_YAML)
+    run_cli(tmp_path, "apply", "-f", str(setup))
+    pods = tmp_path / "pods.yaml"
+    pods.write_text("""
+apiVersion: v1
+kind: Pod
+metadata:
+  name: running-1
+  labels:
+    kueue.x-k8s.io/queue-name: user-queue
+spec:
+  containers:
+  - name: c
+    resources:
+      requests:
+        cpu: "1"
+---
+apiVersion: v1
+kind: Pod
+metadata:
+  name: no-queue
+spec:
+  containers:
+  - name: c
+    resources:
+      requests:
+        cpu: "1"
+""")
+    capsys.readouterr()
+    assert run_cli(tmp_path, "import", "-f", str(pods)) == 0
+    out = capsys.readouterr().out
+    assert "imported 1 pods (1 skipped)" in out
+    driver = build_driver(Store(str(tmp_path)))
+    assert "default/pod-running-1" in driver.admitted_keys()
+
+
+def make_driver_with_pending():
+    d = Driver(clock=lambda: 1000.0)
+    d.apply_resource_flavor(ResourceFlavor(name="default"))
+    d.apply_cluster_queue(ClusterQueue(
+        name="cq", resource_groups=[ResourceGroup(
+            covered_resources=["cpu"],
+            flavors=[FlavorQuotas(name="default", resources={
+                "cpu": ResourceQuota(nominal=1000)})])]))
+    d.apply_local_queue(LocalQueue(name="lq", cluster_queue="cq"))
+    for i in range(4):
+        d.create_workload(Workload(
+            name=f"w{i}", queue_name="lq", priority=i,
+            creation_time=float(i + 1),
+            pod_sets=[PodSet(name="main", count=1,
+                             requests={"cpu": 1000})]))
+    d.run_until_settled()
+    return d
+
+
+def test_visibility_positions():
+    d = make_driver_with_pending()
+    svc = VisibilityService(d)
+    summary = svc.pending_workloads_cq("cq")
+    # w3 admitted (highest priority); w2, w1, w0 pending by priority desc
+    names = [w.name for w in summary.items]
+    assert names == ["w2", "w1", "w0"]
+    assert [w.position_in_cluster_queue for w in summary.items] == [0, 1, 2]
+    lq_summary = svc.pending_workloads_lq("default", "lq")
+    assert [w.position_in_local_queue for w in lq_summary.items] == [0, 1, 2]
+    limited = svc.pending_workloads_cq("cq", limit=1, offset=1)
+    assert [w.name for w in limited.items] == ["w1"]
+
+
+def test_visibility_http_server():
+    d = make_driver_with_pending()
+    server = VisibilityServer(d)
+    port = server.start()
+    try:
+        url = (f"http://127.0.0.1:{port}/apis/visibility/v1beta1/"
+               f"clusterqueues/cq/pendingworkloads")
+        body = json.loads(urllib.request.urlopen(url, timeout=5).read())
+        assert [w["name"] for w in body["items"]] == ["w2", "w1", "w0"]
+        url2 = f"http://127.0.0.1:{port}/apis/visibility/v1beta1/clusterqueues"
+        body2 = json.loads(urllib.request.urlopen(url2, timeout=5).read())
+        assert body2["cq"]["pending"] == 3
+        bad = f"http://127.0.0.1:{port}/apis/nope"
+        with pytest.raises(urllib.error.HTTPError):
+            urllib.request.urlopen(bad, timeout=5)
+    finally:
+        server.stop()
+
+
+def test_debugger_dump():
+    d = make_driver_with_pending()
+    text = dump_state(d)
+    assert "cq: 3 pending" in text
+    assert "default/w3" in text
