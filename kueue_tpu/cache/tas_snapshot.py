@@ -25,7 +25,7 @@ from ..api.types import (
 from .tas_cache import NodeInfo
 
 
-@dataclass
+@dataclass(eq=False)  # identity hash: domains are keyed in chosen-maps
 class Domain:
     """One topology domain (reference tas_flavor_snapshot.go `domain`)."""
     id: tuple                      # label values from root level to this level

@@ -333,6 +333,24 @@ class Driver:
         if cq_name:
             self.queues.queue_inadmissible_workloads([cq_name])
 
+    def check_maximum_execution_times(self) -> list[str]:
+        """Deactivate workloads admitted longer than their
+        maximumExecutionTimeSeconds (reference workload_controller.go:354).
+        Returns the deactivated keys."""
+        now = self.clock()
+        out = []
+        for key, wl in list(self.workloads.items()):
+            limit = wl.maximum_execution_time_seconds
+            if limit is None or not wl.is_admitted or wl.is_finished:
+                continue
+            adm = wl.conditions.get(WL_ADMITTED)
+            if adm is not None and now - adm.last_transition_time >= limit:
+                self.deactivate_workload(key)
+                self.events.append(("MaximumExecutionTimeExceeded", key,
+                                    f"exceeded {limit}s"))
+                out.append(key)
+        return out
+
     def evict_for_pods_ready_timeout(self, key: str) -> None:
         """WaitForPodsReady timeout (reference workload_controller.go:546)."""
         wl = self.workloads.get(key)

@@ -1,0 +1,181 @@
+"""Topology-aware scheduling on device (SURVEY §7 stage 7).
+
+The domain tree (block → rack → host) becomes level-indexed CSR arrays;
+the reference's two-phase algorithm (tas_flavor_snapshot.go:406-613)
+becomes:
+
+- phase 1 ``fill_counts``: leaf fits = min over resources of
+  free // per_pod, then a segment-sum up the levels (one scatter-add per
+  level — XLA turns these into efficient one-pass reductions);
+- phase 2 ``best_fit_descend``: pick the best domain at the requested
+  level (least spare capacity, BestFit), then descend level by level
+  allocating children in (-state, id) order via in-segment prefix sums —
+  no data-dependent Python control flow, one jit for the whole query.
+
+Domains at every level are packed sorted by id tuple, so children of one
+parent are contiguous and segment ops are contiguous-range ops, and index
+order is id order (the reference's tie-break).
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..cache.tas_snapshot import TASFlavorSnapshot
+
+I32_MAX = 2**31 - 1
+
+
+@dataclass
+class PackedTAS:
+    levels: list[str]
+    level_sizes: list[int]            # domains per level, top→bottom
+    parents: list[np.ndarray]         # parents[l]: [N_{l+1}] → level-l idx
+    leaf_free: np.ndarray             # [N_leaf, R] int32
+    resource_names: list[str]
+    leaf_ids: list[tuple]             # leaf idx → id tuple
+    domain_ids: list[list[tuple]]     # per level, idx → id tuple
+
+
+def pack_tas(snap: TASFlavorSnapshot) -> PackedTAS:
+    L = len(snap.levels)
+    domain_ids = [sorted(d.id for d in snap.domains_per_level[lvl])
+                  for lvl in range(L)]
+    idx = [{did: i for i, did in enumerate(domain_ids[lvl])}
+           for lvl in range(L)]
+    parents = []
+    for lvl in range(1, L):
+        par = np.array([idx[lvl - 1][did[:lvl]] for did in domain_ids[lvl]],
+                       dtype=np.int32)
+        parents.append(par)
+    resources = sorted({r for leaf in snap.leaves.values()
+                        for r in leaf.free})
+    r_index = {r: i for i, r in enumerate(resources)}
+    leaf_ids = domain_ids[L - 1]
+    leaf_free = np.zeros((max(1, len(leaf_ids)), max(1, len(resources))),
+                         dtype=np.int64)
+    for i, did in enumerate(leaf_ids):
+        for r, v in snap.leaves[did].free.items():
+            leaf_free[i, r_index[r]] = min(max(v, 0), I32_MAX)
+    return PackedTAS(levels=list(snap.levels),
+                     level_sizes=[len(d) for d in domain_ids],
+                     parents=parents,
+                     leaf_free=leaf_free.astype(np.int32),
+                     resource_names=resources,
+                     leaf_ids=list(leaf_ids), domain_ids=domain_ids)
+
+
+# ---------------------------------------------------------------------------
+# Phase 1: counts up the tree
+# ---------------------------------------------------------------------------
+
+@partial(jax.jit, static_argnames=("level_sizes",))
+def fill_counts(leaf_free, per_pod, parents, *, level_sizes: tuple[int, ...]):
+    """Returns states per level (tuple of arrays, top→bottom).
+
+    leaf_free: [N_leaf, R]; per_pod: [R] (0 = not requested);
+    parents: tuple of [N_{l+1}] arrays.
+    """
+    needed = per_pod > 0
+    fits_r = jnp.where(needed[None, :],
+                       leaf_free // jnp.maximum(per_pod, 1)[None, :],
+                       I32_MAX)
+    leaf_state = jnp.min(fits_r, axis=1)          # [N_leaf]
+    leaf_state = jnp.where(jnp.any(needed), leaf_state, 0)
+    states = [leaf_state]
+    for lvl in range(len(level_sizes) - 2, -1, -1):
+        child_state = states[0]
+        par = parents[lvl]
+        state = jnp.zeros(level_sizes[lvl],
+                          dtype=child_state.dtype).at[par].add(child_state)
+        states.insert(0, state)
+    return tuple(states)
+
+
+# ---------------------------------------------------------------------------
+# Phase 2: best-fit selection + descent
+# ---------------------------------------------------------------------------
+
+def _best_at_level(state, count):
+    """Least spare capacity among domains fitting `count`; ties by index
+    (= id order).  Returns -1 when none fits."""
+    fits = state >= count
+    key = jnp.where(fits, state, I32_MAX)
+    best = jnp.argmin(key)                        # ties → lowest index
+    return jnp.where(jnp.any(fits), best, -1)
+
+
+def _allocate_level(parent_counts, par, state):
+    """Distribute parent counts over children in (-state, idx) order.
+
+    parent_counts: [N_l]; par: [N_{l+1}] parent idx; state: [N_{l+1}].
+    Returns child_counts [N_{l+1}].
+    """
+    n = state.shape[0]
+    order = jnp.lexsort((jnp.arange(n), -state, par))   # group, then -state
+    par_o = par[order]
+    state_o = state[order]
+    # in-segment exclusive prefix sum of state (segments = equal par_o runs)
+    csum = jnp.cumsum(state_o)
+    first_of_seg = jnp.concatenate(
+        [jnp.array([True]), par_o[1:] != par_o[:-1]])
+    # running max of the segment-start cumsum works because csum is
+    # nondecreasing, so each segment's base dominates all earlier ones
+    seg_base = jax.lax.associative_scan(jnp.maximum,
+                                        jnp.where(first_of_seg,
+                                                  csum - state_o, 0))
+    prev = (csum - state_o) - seg_base
+    cnt_o = parent_counts[par_o]
+    take_o = jnp.clip(cnt_o - prev, 0, state_o)
+    out = jnp.zeros(n, dtype=parent_counts.dtype).at[order].set(take_o)
+    return out
+
+
+@partial(jax.jit, static_argnames=("level_sizes", "level"))
+def best_fit_descend(leaf_free, per_pod, parents, count,
+                     *, level_sizes: tuple[int, ...], level: int):
+    """Single-domain BestFit at `level` + descent to leaf counts.
+
+    Returns (ok bool, leaf_counts [N_leaf] int32); ok=False when no
+    single domain at `level` fits `count`."""
+    states = fill_counts(leaf_free, per_pod, parents,
+                         level_sizes=level_sizes)
+    best = _best_at_level(states[level], count)
+    ok = best >= 0
+    counts = jnp.zeros(level_sizes[level], dtype=jnp.int32)
+    counts = counts.at[jnp.maximum(best, 0)].set(
+        jnp.where(ok, count, 0).astype(jnp.int32))
+    for lvl in range(level, len(level_sizes) - 1):
+        counts = _allocate_level(counts, parents[lvl], states[lvl + 1])
+    return ok, counts
+
+
+@partial(jax.jit, static_argnames=("level_sizes",))
+def split_across_roots(leaf_free, per_pod, parents, count,
+                       *, level_sizes: tuple[int, ...]):
+    """The unconstrained / final-fallback path: split over root domains,
+    largest first (reference `unconstrained` + root split), then descend.
+
+    Returns (ok, leaf_counts)."""
+    states = fill_counts(leaf_free, per_pod, parents,
+                         level_sizes=level_sizes)
+    root_state = states[0]
+    total = jnp.sum(root_state)
+    ok = total >= count
+    # take from largest roots first (fewest domains)
+    n = root_state.shape[0]
+    order = jnp.lexsort((jnp.arange(n), -root_state))
+    state_o = root_state[order]
+    prev = jnp.cumsum(state_o) - state_o
+    take_o = jnp.clip(count - prev, 0, state_o)
+    counts = jnp.zeros(n, dtype=jnp.int32).at[order].set(
+        take_o.astype(jnp.int32))
+    counts = jnp.where(ok, counts, 0)
+    for lvl in range(0, len(level_sizes) - 1):
+        counts = _allocate_level(counts, parents[lvl], states[lvl + 1])
+    return ok, counts
