@@ -97,7 +97,6 @@ def build(scale: float):
 
 def run(d: Driver, clock: VirtualClock, total: int):
     finished = 0
-    admitted_seen: set[str] = set()
     running: list[tuple[int, str]] = []   # (finish_at_cycle, key)
     cycle = 0
     cycle_times = []
@@ -106,22 +105,21 @@ def run(d: Driver, clock: VirtualClock, total: int):
         cycle += 1
         clock.t += 1.0
         c0 = time.perf_counter()
-        d.schedule_once()
+        stats = d.schedule_once()
         cycle_times.append(time.perf_counter() - c0)
-        now_admitted = d.admitted_keys()
-        for key in now_admitted - admitted_seen:
+        for key in stats.admitted:
             running.append((cycle + RUNTIME_CYCLES, key))
-        admitted_seen |= now_admitted
         still = []
         for finish_at, key in running:
-            if finish_at <= cycle and key in now_admitted:
+            wl = d.workloads.get(key)
+            if wl is None or not wl.has_quota_reservation:
+                continue  # evicted/preempted: re-tracked when re-admitted
+            if finish_at <= cycle:
                 d.finish_workload(key)
                 finished += 1
-            elif key in now_admitted:
+            else:
                 still.append((finish_at, key))
-            # evicted/preempted workloads re-enter via admitted_seen reset
         running = still
-        admitted_seen &= d.admitted_keys()
         if cycle > total * 4 + 1000:
             print(f"bench stalled: cycle={cycle} finished={finished}/{total}",
                   file=sys.stderr)

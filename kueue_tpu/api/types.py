@@ -475,8 +475,40 @@ class Workload:
             last_transition_time=now, observed_generation=self.generation)
 
     def clone(self) -> "Workload":
-        import copy
-        return copy.deepcopy(self)
+        """Structural copy without deepcopy (the admit path clones every
+        workload once per admission — reference SSA builds a fresh apply
+        configuration instead)."""
+        import copy as _copy
+        import dataclasses as _dc
+        new = _copy.copy(self)
+        new.pod_sets = [
+            _dc.replace(ps,
+                        requests=dict(ps.requests),
+                        node_selector=dict(ps.node_selector),
+                        tolerations=list(ps.tolerations),
+                        labels=dict(ps.labels),
+                        annotations=dict(ps.annotations),
+                        scheduling_gates=list(ps.scheduling_gates),
+                        required_node_affinity={
+                            k: list(v) for k, v
+                            in ps.required_node_affinity.items()})
+            for ps in self.pod_sets]
+        if self.admission is not None:
+            new.admission = Admission(
+                cluster_queue=self.admission.cluster_queue,
+                pod_set_assignments=[
+                    _dc.replace(a, flavors=dict(a.flavors),
+                                resource_usage=dict(a.resource_usage))
+                    for a in self.admission.pod_set_assignments])
+        new.conditions = dict(self.conditions)
+        new.admission_check_states = {
+            k: _dc.replace(v, pod_set_updates=list(v.pod_set_updates))
+            for k, v in self.admission_check_states.items()}
+        if self.requeue_state is not None:
+            new.requeue_state = _dc.replace(self.requeue_state)
+        new.reclaimable_pods = list(self.reclaimable_pods)
+        new.scheduling_stats_evictions = dict(self.scheduling_stats_evictions)
+        return new
 
 
 @dataclass
