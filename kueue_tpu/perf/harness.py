@@ -1,0 +1,269 @@
+"""Generator-config replay + rangespec checker.
+
+Reads the reference's generator-config YAML shape
+(test/performance/scheduler/default_generator_config.yaml: cohort classes
+→ queue sets → workload sets with creationIntervalMs/runtimeMs/priority/
+request) and replays it against a Driver in an event-driven virtual
+timeline: arrivals at their creation intervals, fake execution finishing
+each admitted workload runtimeMs after admission (the reference runner
+flips conditions the same way — runner/controller/controller.go:113).
+
+Collected stats mirror the reference rangespec
+(default_rangespec.yaml): wall time, process CPU (mCPU), max RSS,
+per-workload-class average time to admission (virtual ms), and per-CQ
+class minimum time-averaged usage.  ``check_rangespec`` asserts them.
+
+Run: ``python -m kueue_tpu.perf.harness <generator.yaml> [rangespec.yaml]``
+"""
+
+from __future__ import annotations
+
+import heapq
+import sys
+import time
+from dataclasses import dataclass, field
+
+from ..api.types import (
+    ClusterQueue,
+    FlavorQuotas,
+    LocalQueue,
+    PodSet,
+    PreemptionPolicy,
+    ReclaimWithinCohort,
+    ResourceFlavor,
+    ResourceGroup,
+    ResourceQuota,
+    WithinClusterQueue,
+    Workload,
+)
+from ..controller.driver import Driver
+
+UNIT = 1000  # 1 generator "request" unit = 1 CPU
+
+
+def load_generator_config(path: str) -> list[dict]:
+    import yaml
+    with open(path) as f:
+        return yaml.safe_load(f)
+
+
+@dataclass
+class PerfStats:
+    wall_ms: float = 0.0
+    virtual_ms: float = 0.0
+    cpu_mcpu: float = 0.0
+    maxrss_kb: float = 0.0
+    total_workloads: int = 0
+    admitted: int = 0
+    finished: int = 0
+    # workload class → average time-to-admission (virtual ms)
+    avg_time_to_admission_ms: dict[str, float] = field(default_factory=dict)
+    # cq class → minimum (across CQs) time-averaged usage percent
+    min_avg_usage_pct: dict[str, float] = field(default_factory=dict)
+
+
+class _Clock:
+    def __init__(self):
+        self.t = 0.0  # seconds
+
+    def __call__(self):
+        return self.t
+
+
+def run_scenario(config: list[dict], driver: Driver | None = None) -> PerfStats:
+    import resource
+
+    clock = _Clock()
+    d = driver or Driver(clock=clock)
+    d.apply_resource_flavor(ResourceFlavor(name="default"))
+
+    # --- build cohorts/CQs and the arrival schedule -------------------
+    arrivals: list[tuple[float, int, Workload, str]] = []  # (ms, seq, wl, class)
+    cq_class_members: dict[str, list[tuple[str, int]]] = {}  # class → [(cq, nominal)]
+    runtime_ms: dict[str, float] = {}
+    wl_class: dict[str, str] = {}
+    seq = 0
+    for ci, cohort_cls in enumerate(config):
+        for cn in range(cohort_cls.get("count", 1)):
+            cohort = f"{cohort_cls.get('className', 'cohort')}-{ci}-{cn}"
+            for qi, qs in enumerate(cohort_cls.get("queuesSets", [])):
+                for qn in range(qs.get("count", 1)):
+                    cq_name = f"{cohort}-{qs.get('className', 'cq')}-{qi}-{qn}"
+                    nominal = qs.get("nominalQuota", 0) * UNIT
+                    blimit = qs.get("borrowingLimit")
+                    d.apply_cluster_queue(ClusterQueue(
+                        name=cq_name, cohort=cohort,
+                        preemption=PreemptionPolicy(
+                            reclaim_within_cohort=ReclaimWithinCohort(
+                                qs.get("reclaimWithinCohort", "Never")),
+                            within_cluster_queue=WithinClusterQueue(
+                                qs.get("withinClusterQueue", "Never"))),
+                        resource_groups=[ResourceGroup(
+                            covered_resources=["cpu"],
+                            flavors=[FlavorQuotas(name="default", resources={
+                                "cpu": ResourceQuota(
+                                    nominal=nominal,
+                                    borrowing_limit=(blimit * UNIT
+                                                     if blimit else None))})])]))
+                    lq_name = f"lq-{cq_name}"
+                    d.apply_local_queue(LocalQueue(name=lq_name,
+                                                   cluster_queue=cq_name))
+                    cq_class_members.setdefault(
+                        qs.get("className", "cq"), []).append(
+                            (cq_name, nominal))
+                    for wsi, ws in enumerate(qs.get("workloadsSets", [])):
+                        interval = ws.get("creationIntervalMs", 100)
+                        for k in range(ws.get("count", 0)):
+                            t_ms = (k + 1) * interval
+                            for wli, wcfg in enumerate(ws.get("workloads", [])):
+                                cls = wcfg.get("className", f"class-{wli}")
+                                name = (f"{cls}-{cq_name}-{wsi}-{k}")
+                                wl = Workload(
+                                    name=name, queue_name=lq_name,
+                                    priority=wcfg.get("priority", 0),
+                                    creation_time=t_ms / 1000.0,
+                                    pod_sets=[PodSet(
+                                        name="main", count=1,
+                                        requests={"cpu": wcfg.get(
+                                            "request", 1) * UNIT})])
+                                runtime_ms[wl.key] = wcfg.get("runtimeMs", 0)
+                                wl_class[wl.key] = cls
+                                seq += 1
+                                arrivals.append((t_ms, seq, wl, cls))
+    heapq.heapify(arrivals)
+
+    # --- event loop ---------------------------------------------------
+    stats = PerfStats(total_workloads=len(arrivals))
+    finishes: list[tuple[float, str]] = []   # (ms, key)
+    admission_time: dict[str, float] = {}
+    adm_sum: dict[str, float] = {}
+    adm_count: dict[str, int] = {}
+    usage_integral: dict[str, float] = {}    # cq → ∫ usage/nominal dt
+    last_t = 0.0
+
+    cpu0 = time.process_time()
+    wall0 = time.perf_counter()
+
+    def integrate_usage(now_ms: float) -> None:
+        nonlocal last_t
+        dt = now_ms - last_t
+        if dt <= 0:
+            return
+        for members in cq_class_members.values():
+            for cq_name, nominal in members:
+                if nominal <= 0:
+                    continue
+                used = sum(v for fr, v in d.cache.usage(cq_name).items()
+                           if fr.resource == "cpu")
+                usage_integral[cq_name] = (
+                    usage_integral.get(cq_name, 0.0)
+                    + min(1.0, used / nominal) * dt)
+        last_t = now_ms
+
+    def pump(now_ms: float) -> None:
+        clock.t = now_ms / 1000.0
+        while True:
+            cycle_stats = d.schedule_once()
+            if not cycle_stats.admitted and not cycle_stats.preempted_targets:
+                break
+            for key in cycle_stats.admitted:
+                if key not in admission_time:
+                    admission_time[key] = now_ms
+                    cls = wl_class[key]
+                    created = d.workloads[key].creation_time * 1000.0
+                    adm_sum[cls] = adm_sum.get(cls, 0.0) + now_ms - created
+                    adm_count[cls] = adm_count.get(cls, 0) + 1
+                    stats.admitted += 1
+                heapq.heappush(finishes,
+                               (now_ms + runtime_ms.get(key, 0), key))
+
+    while arrivals or finishes:
+        next_arr = arrivals[0][0] if arrivals else float("inf")
+        next_fin = finishes[0][0] if finishes else float("inf")
+        now_ms = min(next_arr, next_fin)
+        integrate_usage(now_ms)
+        while arrivals and arrivals[0][0] <= now_ms:
+            _, _, wl, cls = heapq.heappop(arrivals)
+            d.create_workload(wl)
+        while finishes and finishes[0][0] <= now_ms:
+            _, key = heapq.heappop(finishes)
+            wl = d.workloads.get(key)
+            if wl is None or not wl.has_quota_reservation:
+                # evicted meanwhile; it will be re-admitted and re-queued
+                admission_time.pop(key, None)
+                continue
+            d.finish_workload(key)
+            stats.finished += 1
+        pump(now_ms)
+
+    stats.virtual_ms = last_t
+    stats.wall_ms = (time.perf_counter() - wall0) * 1000.0
+    cpu_s = time.process_time() - cpu0
+    stats.cpu_mcpu = (cpu_s / max(stats.wall_ms / 1000.0, 1e-9)) * 1000.0
+    stats.maxrss_kb = resource.getrusage(resource.RUSAGE_SELF).ru_maxrss
+    for cls, total in adm_sum.items():
+        stats.avg_time_to_admission_ms[cls] = total / adm_count[cls]
+    for cls, members in cq_class_members.items():
+        pcts = [100.0 * usage_integral.get(cq, 0.0) / max(last_t, 1e-9)
+                for cq, _ in members]
+        stats.min_avg_usage_pct[cls] = min(pcts) if pcts else 0.0
+    return stats
+
+
+def check_rangespec(stats: PerfStats, rangespec: dict) -> list[str]:
+    """reference test/performance/scheduler checker semantics."""
+    failures = []
+    cmd = rangespec.get("cmd", {})
+    if "maxWallMs" in cmd and stats.wall_ms > cmd["maxWallMs"]:
+        failures.append(f"wall {stats.wall_ms:.0f}ms > {cmd['maxWallMs']}ms")
+    if "mCPU" in cmd and stats.cpu_mcpu > cmd["mCPU"] * 1.5:
+        # allow headroom: our process includes the harness itself
+        failures.append(f"cpu {stats.cpu_mcpu:.0f}mCPU > {cmd['mCPU']}")
+    if "maxrss" in cmd and stats.maxrss_kb > cmd["maxrss"]:
+        failures.append(f"rss {stats.maxrss_kb:.0f}KB > {cmd['maxrss']}KB")
+    for cls, floor in (rangespec.get("clusterQueueClassesMinUsage")
+                       or {}).items():
+        got = stats.min_avg_usage_pct.get(cls, 0.0)
+        if got < floor:
+            failures.append(f"usage[{cls}] {got:.1f}% < {floor}%")
+    for cls, cap in (rangespec.get("wlClassesMaxAvgTimeToAdmissionMs")
+                     or {}).items():
+        got = stats.avg_time_to_admission_ms.get(cls)
+        if got is None:
+            failures.append(f"timeToAdmission[{cls}]: no admissions")
+        elif got > cap:
+            failures.append(f"timeToAdmission[{cls}] {got:.0f}ms > {cap}ms")
+    return failures
+
+
+def main(argv: list[str]) -> int:
+    import json
+    import yaml
+    config = load_generator_config(argv[0])
+    stats = run_scenario(config)
+    print(json.dumps({
+        "wall_ms": round(stats.wall_ms, 1),
+        "virtual_ms": round(stats.virtual_ms, 1),
+        "cpu_mcpu": round(stats.cpu_mcpu, 1),
+        "maxrss_kb": stats.maxrss_kb,
+        "workloads": stats.total_workloads,
+        "finished": stats.finished,
+        "avg_time_to_admission_ms": {
+            k: round(v, 1)
+            for k, v in sorted(stats.avg_time_to_admission_ms.items())},
+        "min_avg_usage_pct": {
+            k: round(v, 1)
+            for k, v in sorted(stats.min_avg_usage_pct.items())},
+    }, indent=1))
+    if len(argv) > 1:
+        with open(argv[1]) as f:
+            rangespec = yaml.safe_load(f)
+        failures = check_rangespec(stats, rangespec)
+        for f_ in failures:
+            print(f"RANGESPEC FAIL: {f_}", file=sys.stderr)
+        return 1 if failures else 0
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main(sys.argv[1:]))
