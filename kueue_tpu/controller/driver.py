@@ -74,6 +74,7 @@ class Driver:
                  wait_for_pods_ready: WaitForPodsReadyConfig | None = None,
                  namespaces: Optional[dict[str, dict[str, str]]] = None,
                  use_device_solver: bool = False,
+                 solver_backend: str = "device",
                  validate: bool = True):
         self.clock = clock
         self.wait_for_pods_ready = wait_for_pods_ready or WaitForPodsReadyConfig()
@@ -89,7 +90,8 @@ class Driver:
             ordering=ordering, clock=clock, namespaces=namespaces)
         if use_device_solver:
             from ..ops.solver import CycleSolver
-            self.scheduler.solver = CycleSolver(ordering)
+            self.scheduler.solver = CycleSolver(ordering,
+                                                backend=solver_backend)
         self.scheduler.apply_admission = self._apply_admission
         self.scheduler.preemptor.apply_preemption = self._apply_preemption
         # durable store: the CRD-status equivalent

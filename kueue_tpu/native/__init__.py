@@ -1,0 +1,98 @@
+"""Native (C++) solver-plane backend.
+
+``classify_cycle(packed)`` runs the batched nominate/classify pass in the
+compiled core (kueue_tpu/native/cycle_core.cpp) — identical decisions to
+the JAX kernel (ops/cycle.solve_cycle, run_scan=False) and the scalar
+host oracle.  The shared library is built lazily with g++ on first use
+and cached next to the source.
+"""
+
+from __future__ import annotations
+
+import ctypes
+import os
+import subprocess
+import threading
+
+import numpy as np
+
+_HERE = os.path.dirname(os.path.abspath(__file__))
+_SRC = os.path.join(_HERE, "cycle_core.cpp")
+_LIB = os.path.join(_HERE, "libcyclecore.so")
+
+_lock = threading.Lock()
+_lib = None
+
+
+class NativeBuildError(RuntimeError):
+    pass
+
+
+def _build() -> None:
+    cmd = ["g++", "-O2", "-shared", "-fPIC", "-o", _LIB, _SRC]
+    proc = subprocess.run(cmd, capture_output=True, text=True)
+    if proc.returncode != 0:
+        raise NativeBuildError(
+            f"building cycle core failed: {proc.stderr[-2000:]}")
+
+
+def _load():
+    global _lib
+    with _lock:
+        if _lib is not None:
+            return _lib
+        if (not os.path.exists(_LIB)
+                or os.path.getmtime(_LIB) < os.path.getmtime(_SRC)):
+            _build()
+        lib = ctypes.CDLL(_LIB)
+        i32p = np.ctypeslib.ndpointer(np.int32, flags="C_CONTIGUOUS")
+        u8p = np.ctypeslib.ndpointer(np.uint8, flags="C_CONTIGUOUS")
+        lib.classify_cycle.restype = None
+        lib.classify_cycle.argtypes = (
+            [ctypes.c_int32] * 6
+            + [i32p, i32p, i32p, i32p, u8p, i32p, i32p, i32p, u8p, u8p,
+               i32p, i32p]
+            + [i32p, u8p, u8p])
+        _lib = lib
+        return lib
+
+
+def available() -> bool:
+    """Whether the native backend can be used (g++ present or prebuilt)."""
+    if os.path.exists(_LIB):
+        return True
+    from shutil import which
+    return which("g++") is not None
+
+
+def classify_cycle(packed):
+    """Run the native classify over a PackedCycle.
+
+    Returns (fit_slot0 [W] int32, borrows0 [W] bool, preempt [W] bool),
+    matching ops/cycle.solve_cycle(..., run_scan=False) outputs 4-6.
+    """
+    lib = _load()
+    N = packed.node_count
+    C, S, R = packed.slot_fr.shape
+    F = packed.usage0.shape[1]
+    W = packed.wl_cq.shape[0]
+
+    def i32(a):
+        return np.ascontiguousarray(a, dtype=np.int32)
+
+    def u8(a):
+        return np.ascontiguousarray(a, dtype=np.uint8)
+
+    fit_slot = np.empty(W, dtype=np.int32)
+    borrows = np.empty(W, dtype=np.uint8)
+    preempt = np.empty(W, dtype=np.uint8)
+    lib.classify_cycle(
+        N, F, C, S, R, W,
+        i32(packed.usage0), i32(packed.subtree_quota),
+        i32(packed.guaranteed), i32(packed.borrow_cap),
+        u8(packed.has_borrow_limit), i32(packed.parent),
+        i32(packed.nominal_cq), i32(packed.slot_fr),
+        u8(packed.slot_valid), u8(packed.cq_can_preempt_borrow),
+        i32(packed.wl_cq), i32(packed.wl_requests),
+        fit_slot, borrows, preempt)
+    return fit_slot, borrows.astype(bool), preempt.astype(bool)

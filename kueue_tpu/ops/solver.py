@@ -32,10 +32,16 @@ _DEFAULT_FF = FlavorFungibility()
 
 
 class CycleSolver:
-    """Batched device solver for pure-Fit cycles."""
+    """Batched solver for pure-Fit cycles.
 
-    def __init__(self, ordering: Ordering | None = None):
+    backend="device" runs the jitted JAX kernel (TPU/CPU via XLA);
+    backend="native" runs the C++ core (kueue_tpu/native) — identical
+    decisions either way."""
+
+    def __init__(self, ordering: Ordering | None = None,
+                 backend: str = "device"):
         self.ordering = ordering or Ordering()
+        self.backend = backend
         self.stats = {"device_cycles": 0, "host_fallbacks": 0}
 
     # -- eligibility ---------------------------------------------------
@@ -92,17 +98,22 @@ class CycleSolver:
             # lossy int32 scaling could deny fits the host grants
             self.stats["host_fallbacks"] += 1
             return None
-        (_admitted, _slots, _borrows, preempt_possible,
-         fit_slot0, borrows0) = solve_cycle(
-            packed.usage0, packed.subtree_quota, packed.guaranteed,
-            packed.borrow_cap, packed.has_borrow_limit, packed.parent,
-            packed.nominal_cq, packed.slot_fr, packed.slot_valid,
-            packed.cq_can_preempt_borrow,
-            packed.wl_cq, packed.wl_requests, packed.wl_priority,
-            packed.wl_timestamp, depth=packed.depth, run_scan=False)
-        fit_slot0 = np.asarray(fit_slot0)
-        borrows0 = np.asarray(borrows0)
-        preempt_possible = np.asarray(preempt_possible)
+        if self.backend == "native":
+            from .. import native
+            fit_slot0, borrows0, preempt_possible = native.classify_cycle(
+                packed)
+        else:
+            (_admitted, _slots, _borrows, preempt_possible,
+             fit_slot0, borrows0) = solve_cycle(
+                packed.usage0, packed.subtree_quota, packed.guaranteed,
+                packed.borrow_cap, packed.has_borrow_limit, packed.parent,
+                packed.nominal_cq, packed.slot_fr, packed.slot_valid,
+                packed.cq_can_preempt_borrow,
+                packed.wl_cq, packed.wl_requests, packed.wl_priority,
+                packed.wl_timestamp, depth=packed.depth, run_scan=False)
+            fit_slot0 = np.asarray(fit_slot0)
+            borrows0 = np.asarray(borrows0)
+            preempt_possible = np.asarray(preempt_possible)
         n = packed.wl_count
         if preempt_possible[:n].any():
             # preemption semantics stay on host for now

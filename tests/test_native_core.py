@@ -1,0 +1,56 @@
+"""Native (C++) cycle-core parity: identical decisions to the JAX kernel
+and the scalar host oracle."""
+
+import random
+
+import numpy as np
+import pytest
+
+from kueue_tpu import native
+from kueue_tpu.ops.cycle import solve_cycle
+from kueue_tpu.ops.packing import pack_cycle
+from kueue_tpu.parallel import cycle_args
+
+pytestmark = pytest.mark.skipif(not native.available(),
+                                reason="no g++ / prebuilt core")
+
+
+def _packed(seed=0, **kw):
+    import __graft_entry__ as ge
+    _, _, _, packed = ge._packed_cycle(**kw)
+    return packed
+
+
+def test_native_matches_device_kernel():
+    packed = _packed()
+    out = solve_cycle(*cycle_args(packed), depth=packed.depth,
+                      run_scan=False)
+    dev_preempt, dev_fit, dev_borrow = [np.asarray(o) for o in out[3:6]]
+    nat_fit, nat_borrow, nat_preempt = native.classify_cycle(packed)
+    np.testing.assert_array_equal(nat_fit, dev_fit)
+    np.testing.assert_array_equal(nat_borrow, dev_borrow)
+    np.testing.assert_array_equal(nat_preempt, dev_preempt)
+    assert (nat_fit >= 0).any()
+
+
+@pytest.mark.parametrize("seed", [1, 2, 3])
+def test_native_end_to_end_parity_vs_host(seed):
+    from tests.test_solver_parity import build_driver
+    results = []
+    for backend in (None, "native"):
+        d, workloads = build_driver(seed, backend is not None)
+        if backend is not None:
+            d.scheduler.solver.backend = backend
+        for wl in workloads:
+            d.create_workload(wl)
+        d.run_until_settled(max_cycles=300)
+        admitted = {}
+        for k in d.admitted_keys():
+            wl = d.workload(k)
+            admitted[k] = tuple(sorted(
+                (a.name, a.count, tuple(sorted(a.flavors.items())))
+                for a in wl.admission.pod_set_assignments))
+        results.append((admitted, d))
+    (host, _), (nat, d_nat) = results
+    assert host == nat
+    assert d_nat.scheduler.solver.stats["device_cycles"] >= 1
