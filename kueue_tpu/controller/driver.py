@@ -100,6 +100,7 @@ class Driver:
         self.validate = validate
         self.events: list[tuple[str, str, str]] = []  # (kind, key, note)
         self.metrics = metrics.Registry()
+        self.scheduler.metrics = self.metrics
 
     # ------------------------------------------------------------------
     # Resource plumbing (reconciler-equivalents)
@@ -269,9 +270,13 @@ class Driver:
         st.last_transition_time = now
         if state == AdmissionCheckState.READY:
             if sync_admitted_condition(wl, now):
-                self.metrics.admitted_workload(
-                    wl.admission.cluster_queue if wl.admission else "",
-                    now - wl.creation_time)
+                cq_name = wl.admission.cluster_queue if wl.admission else ""
+                self.metrics.admitted_workload(cq_name,
+                                               now - wl.creation_time)
+                reserved = wl.conditions.get(WL_QUOTA_RESERVED)
+                if reserved is not None:
+                    self.metrics.admission_checks_wait(
+                        cq_name, now - reserved.last_transition_time)
                 if wl.admission is not None:
                     info = Info(wl, self.cache.info_options)
                     self.cache.add_or_update_workload(info)
@@ -334,6 +339,48 @@ class Driver:
             self.queues.add_or_update_workload(wl)
         if cq_name:
             self.queues.queue_inadmissible_workloads([cq_name])
+
+    def refresh_resource_metrics(self) -> None:
+        """Per-CQ resource gauges + LQ mirrors (reference
+        ClusterQueueReconciler.recordResourceMetrics,
+        clusterqueue_controller.go:382)."""
+        from ..resources import FlavorResource
+        for name in self.cache.cluster_queue_names():
+            cq = self.cache.cluster_queue(name)
+            if cq is None:
+                continue
+            usage = self.cache.usage(name)
+            for rg in cq.spec.resource_groups:
+                for fq in rg.flavors:
+                    for rname, quota in fq.resources.items():
+                        fr = FlavorResource(fq.name, rname)
+                        used = usage.get(fr, 0)
+                        self.metrics.report_resource_usage(
+                            name, fq.name, rname, used, quota.nominal,
+                            reservation=used,
+                            borrowing_limit=quota.borrowing_limit,
+                            lending_limit=quota.lending_limit)
+        self.metrics.sample_pending(self.queues)
+        # LocalQueue mirrors (LocalQueueMetrics feature gate)
+        from .. import features
+        if features.enabled("LocalQueueMetrics"):
+            per_lq: dict[str, list[int]] = {}
+            for wl in self.workloads.values():
+                key = f"{wl.namespace}/{wl.queue_name}"
+                counts = per_lq.setdefault(key, [0, 0, 0])
+                if wl.is_finished or not wl.is_active:
+                    continue
+                if wl.is_admitted:
+                    counts[2] += 1
+                    counts[1] += 1
+                elif wl.has_quota_reservation:
+                    counts[1] += 1
+                else:
+                    counts[0] += 1
+            for key, (pending, reserving, admitted) in per_lq.items():
+                ns, _, lq = key.partition("/")
+                self.metrics.local_queue_counts(ns, lq, pending,
+                                                reserving, admitted)
 
     def check_maximum_execution_times(self) -> list[str]:
         """Deactivate workloads admitted longer than their

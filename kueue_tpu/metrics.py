@@ -82,6 +82,16 @@ class Registry:
 
     # -- kueue series (reference metrics.go) --
 
+    def cycle_preemption_skip(self) -> None:
+        """reference admission_cycle_preemption_skips (metrics.go)."""
+        self.inc("kueue_admission_cycle_preemption_skips", ())
+
+    def admission_checks_wait(self, cq: str, wait_s: float) -> None:
+        """Time from quota reservation to all checks ready
+        (reference admission_checks_wait_time_seconds)."""
+        self.observe("kueue_admission_checks_wait_time_seconds", (cq,),
+                     wait_s, WAIT_BUCKETS)
+
     def admission_attempt(self, success: bool, duration_s: float) -> None:
         result = "success" if success else "inadmissible"
         self.inc("kueue_admission_attempts_total", (result,))
@@ -130,11 +140,33 @@ class Registry:
                            1.0 if status == current else 0.0)
 
     def report_resource_usage(self, cq: str, flavor: str, resource: str,
-                              usage: float, nominal: float) -> None:
+                              usage: float, nominal: float,
+                              reservation: float | None = None,
+                              borrowing_limit: float | None = None,
+                              lending_limit: float | None = None) -> None:
         self.set_gauge("kueue_cluster_queue_resource_usage",
                        (cq, flavor, resource), usage)
         self.set_gauge("kueue_cluster_queue_resource_nominal_quota",
                        (cq, flavor, resource), nominal)
+        if reservation is not None:
+            self.set_gauge("kueue_cluster_queue_resource_reservation",
+                           (cq, flavor, resource), reservation)
+        if borrowing_limit is not None:
+            self.set_gauge("kueue_cluster_queue_resource_borrowing_limit",
+                           (cq, flavor, resource), borrowing_limit)
+        if lending_limit is not None:
+            self.set_gauge("kueue_cluster_queue_resource_lending_limit",
+                           (cq, flavor, resource), lending_limit)
+
+    def local_queue_counts(self, namespace: str, lq: str, pending: int,
+                           reserving: int, admitted: int) -> None:
+        """local_queue_* mirrors (LocalQueueMetrics feature gate)."""
+        self.set_gauge("kueue_local_queue_pending_workloads",
+                       (namespace, lq), pending)
+        self.set_gauge("kueue_local_queue_reserving_active_workloads",
+                       (namespace, lq), reserving)
+        self.set_gauge("kueue_local_queue_admitted_active_workloads",
+                       (namespace, lq), admitted)
 
     def report_weighted_share(self, cq: str, share: float) -> None:
         self.set_gauge("kueue_cluster_queue_weighted_share", (cq,), share)
@@ -148,19 +180,57 @@ class Registry:
         lines = []
         for key, val in sorted(self.counters.items()):
             name, *labels = key
-            lines.append(f"{name}{_fmt_labels(labels)} {val}")
+            lines.append(f"{name}{_fmt_labels(name, labels)} {val}")
         for key, val in sorted(self.gauges.items()):
             name, *labels = key
-            lines.append(f"{name}{_fmt_labels(labels)} {val}")
+            lines.append(f"{name}{_fmt_labels(name, labels)} {val}")
         for key, h in sorted(self.histograms.items()):
             name, *labels = key
-            lines.append(f"{name}_count{_fmt_labels(labels)} {h.n}")
-            lines.append(f"{name}_sum{_fmt_labels(labels)} {h.total}")
+            lines.append(f"{name}_count{_fmt_labels(name, labels)} {h.n}")
+            lines.append(f"{name}_sum{_fmt_labels(name, labels)} {h.total}")
         return "\n".join(lines) + "\n"
 
 
-def _fmt_labels(labels: list) -> str:
+# Label-name tables per series (reference metrics.go label definitions)
+LABEL_NAMES = {
+    "kueue_admission_attempts_total": ("result",),
+    "kueue_admission_attempt_duration_seconds": ("result",),
+    "kueue_pending_workloads": ("cluster_queue", "status"),
+    "kueue_quota_reserved_workloads_total": ("cluster_queue",),
+    "kueue_quota_reserved_wait_time_seconds": ("cluster_queue",),
+    "kueue_reserving_active_workloads": ("cluster_queue",),
+    "kueue_admitted_workloads_total": ("cluster_queue",),
+    "kueue_admission_wait_time_seconds": ("cluster_queue",),
+    "kueue_admission_checks_wait_time_seconds": ("cluster_queue",),
+    "kueue_admitted_active_workloads": ("cluster_queue",),
+    "kueue_evicted_workloads_total": ("cluster_queue", "reason"),
+    "kueue_preempted_workloads_total": ("preempting_cluster_queue", "reason"),
+    "kueue_cluster_queue_status": ("cluster_queue", "status"),
+    "kueue_cluster_queue_resource_usage":
+        ("cluster_queue", "flavor", "resource"),
+    "kueue_cluster_queue_resource_reservation":
+        ("cluster_queue", "flavor", "resource"),
+    "kueue_cluster_queue_resource_nominal_quota":
+        ("cluster_queue", "flavor", "resource"),
+    "kueue_cluster_queue_resource_borrowing_limit":
+        ("cluster_queue", "flavor", "resource"),
+    "kueue_cluster_queue_resource_lending_limit":
+        ("cluster_queue", "flavor", "resource"),
+    "kueue_cluster_queue_weighted_share": ("cluster_queue",),
+    "kueue_cohort_weighted_share": ("cohort",),
+    "kueue_local_queue_pending_workloads": ("namespace", "local_queue"),
+    "kueue_local_queue_reserving_active_workloads":
+        ("namespace", "local_queue"),
+    "kueue_local_queue_admitted_active_workloads":
+        ("namespace", "local_queue"),
+}
+
+
+def _fmt_labels(name: str, labels: list) -> str:
     if not labels:
         return ""
-    parts = ",".join(f'l{i}="{v}"' for i, v in enumerate(labels))
+    names = LABEL_NAMES.get(name)
+    parts = ",".join(
+        f'{names[i] if names and i < len(names) else f"l{i}"}="{v}"'
+        for i, v in enumerate(labels))
     return "{" + parts + "}"

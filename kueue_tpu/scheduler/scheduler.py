@@ -110,6 +110,8 @@ class Scheduler:
         self.on_requeue: Callable[[Entry], None] = lambda e: None
         # Optional batched device solver (kueue_tpu.ops.solver.CycleSolver).
         self.solver = solver
+        # Optional metrics registry (set by the driver).
+        self.metrics = None
 
     # ------------------------------------------------------------------
     # One cycle — reference scheduler.go:176
@@ -145,6 +147,8 @@ class Scheduler:
             if any(t.info.key in preempted_workloads for t in e.preemption_targets):
                 self._set_skipped(e, "Workload has overlapping preemption "
                                      "targets with another workload")
+                if self.metrics is not None:
+                    self.metrics.cycle_preemption_skip()
                 continue
 
             usage = e.assignment.usage

@@ -117,6 +117,20 @@ class VisibilityServer:
                 pass
 
             def do_GET(self):
+                if self.path.split("?")[0] == "/metrics":
+                    # Prometheus exposition (reference secure metrics
+                    # endpoint, cmd/kueue/main.go:154-179)
+                    driver = service.driver
+                    if hasattr(driver, "refresh_resource_metrics"):
+                        driver.refresh_resource_metrics()
+                    payload = driver.metrics.render().encode()
+                    self.send_response(200)
+                    self.send_header("Content-Type",
+                                     "text/plain; version=0.0.4")
+                    self.send_header("Content-Length", str(len(payload)))
+                    self.end_headers()
+                    self.wfile.write(payload)
+                    return
                 parts = [p for p in self.path.split("?")[0].split("/") if p]
                 # /apis/visibility/v1beta1/clusterqueues/{cq}/pendingworkloads
                 # /apis/visibility/v1beta1/namespaces/{ns}/localqueues/{lq}/pendingworkloads

@@ -245,3 +245,31 @@ def test_debugger_dump():
     text = dump_state(d)
     assert "cq: 3 pending" in text
     assert "default/w3" in text
+
+
+def test_metrics_exposition():
+    from kueue_tpu import features
+    d = make_driver_with_pending()
+    with features.set_feature_gate_during_test("LocalQueueMetrics", True):
+        d.refresh_resource_metrics()
+    text = d.metrics.render()
+    assert ('kueue_cluster_queue_resource_usage'
+            '{cluster_queue="cq",flavor="default",resource="cpu"} 1000'
+            in text)
+    assert ('kueue_pending_workloads{cluster_queue="cq",status="inadmissible"}'
+            in text)
+    assert ('kueue_local_queue_admitted_active_workloads'
+            '{namespace="default",local_queue="lq"} 1' in text)
+    assert 'kueue_admission_attempts_total{result="success"}' in text
+
+
+def test_metrics_http_endpoint():
+    d = make_driver_with_pending()
+    server = VisibilityServer(d)
+    port = server.start()
+    try:
+        body = urllib.request.urlopen(
+            f"http://127.0.0.1:{port}/metrics", timeout=5).read().decode()
+        assert "kueue_cluster_queue_resource_nominal_quota" in body
+    finally:
+        server.stop()
