@@ -1,0 +1,117 @@
+"""Device preemption search (SURVEY §7 stage 5).
+
+The reference's ``minimalPreemptions`` (preemption.go:275-342) — greedily
+remove ordered candidates until the preemptor fits, then fill back in
+reverse — becomes two ``lax.scan``s over the candidate axis:
+
+- forward scan: per candidate, replicate the dynamic skip test (an
+  other-CQ candidate is skipped unless its CQ is *currently* borrowing),
+  the borrowWithinCohort threshold flag flip, the ``remove_usage`` chain
+  walk, and the ``workloadFits`` check; stops removing once fitted;
+- reverse scan (fillBackWorkloads, preemption.go:329): re-add each
+  removed candidate except the fit-achieving one, keep it re-added if
+  the preemptor still fits.
+
+Bit-parity with the host search is enforced by
+tests/test_preemption_kernel.py over random scenarios.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+
+from .quota_kernel import available_all, add_usage_chain
+
+
+def remove_usage_chain(usage, node, delta, guaranteed, parent, depth):
+    """remove_usage bubbling up one ancestor chain
+    (reference resource_node.go:135; host cache/resource_node.remove_usage).
+
+    node: scalar int32; delta: [F] int32 (>=0).  Returns new usage."""
+    def body(i, state):
+        usage, cur, carry = state
+        valid = cur >= 0
+        cur_safe = jnp.maximum(cur, 0)
+        stored_in_parent = usage[cur_safe] - guaranteed[cur_safe]   # [F]
+        sub = jnp.where(valid, carry, 0)
+        usage = usage.at[cur_safe].add(-sub)
+        next_carry = jnp.where(stored_in_parent > 0,
+                               jnp.minimum(carry, stored_in_parent), 0)
+        next_cur = jnp.where(valid, parent[cur_safe], -1)
+        return usage, next_cur, jnp.where(valid, next_carry, carry)
+
+    usage, _, _ = jax.lax.fori_loop(
+        0, depth, body, (usage, node.astype(jnp.int32), delta))
+    return usage
+
+
+@partial(jax.jit, static_argnames=("depth",))
+def minimal_preemptions(usage0, subtree, guaranteed, borrow_cap, has_blim,
+                        parent, preemptor_cq, wl_usage, frs_mask,
+                        cand_cq, cand_delta, cand_other_cq,
+                        cand_above_threshold, allow_borrowing0,
+                        threshold_enabled, *, depth: int):
+    """Returns (fitted bool, target_mask [K] bool).
+
+    wl_usage/cand_delta are in packed-F space (scaled ints); frs_mask
+    marks the flavor-resources needing preemption (for the dynamic
+    is-borrowing skip test, preemption.go _cq_is_borrowing)."""
+    K = cand_cq.shape[0]
+
+    def fits(usage, allow_borrowing):
+        """workloadFits (preemption.go:552)."""
+        avail = available_all(usage, subtree, guaranteed, borrow_cap,
+                              has_blim, parent, depth)[preemptor_cq]
+        relevant = wl_usage > 0
+        ok = jnp.all(jnp.where(relevant, wl_usage <= avail, True))
+        borrowing = jnp.any(jnp.where(
+            relevant, usage[preemptor_cq] + wl_usage > subtree[preemptor_cq],
+            False))
+        return ok & (allow_borrowing | ~borrowing)
+
+    def fwd(carry, k):
+        usage, allow_b, fitted = carry
+        cq = cand_cq[k]
+        # dynamic skip: other-CQ candidates only count while their CQ is
+        # borrowing in a resource needing preemption
+        cand_borrowing = jnp.any((usage[cq] > subtree[cq]) & frs_mask)
+        skip = cand_other_cq[k] & ~cand_borrowing
+        act = ~fitted & ~skip & (cand_cq[k] >= 0)
+        # threshold: an above-threshold other-CQ target disables borrowing
+        allow_b = jnp.where(
+            act & cand_other_cq[k] & threshold_enabled
+            & cand_above_threshold[k],
+            False, allow_b)
+        new_usage = remove_usage_chain(usage, cq, cand_delta[k],
+                                       guaranteed, parent, depth)
+        usage = jnp.where(act, new_usage, usage)
+        now_fits = fits(usage, allow_b)
+        fitted_next = fitted | (act & now_fits)
+        return (usage, allow_b, fitted_next), (act, fitted_next)
+
+    (usage_end, allow_b_end, fitted), (removed, fitted_after) = jax.lax.scan(
+        fwd, (usage0, allow_borrowing0, jnp.asarray(False)), jnp.arange(K))
+
+    # index of the fit-achieving removal (the last removed candidate)
+    removed_idx = jnp.where(removed, jnp.arange(K), -1)
+    last_removed = jnp.max(removed_idx)
+
+    def back(carry, k):
+        usage = carry
+        consider = removed[k] & (k != last_removed) & fitted
+        usage_try = add_usage_chain(usage, cand_cq[k], cand_delta[k],
+                                    guaranteed, parent, depth)
+        still_fits = fits(usage_try, allow_b_end)
+        fill_back = consider & still_fits
+        usage = jnp.where(fill_back, usage_try, usage)
+        return usage, fill_back
+
+    _, filled_back_rev = jax.lax.scan(back, usage_end,
+                                      jnp.arange(K - 1, -1, -1))
+    filled_back = filled_back_rev[::-1]
+
+    target_mask = removed & ~filled_back & fitted
+    return fitted, target_mask

@@ -1,0 +1,115 @@
+"""Host wrapper for the device preemption search.
+
+Packs the snapshot + candidate list and runs
+ops.preemption_kernel.minimal_preemptions; returns the Target list in
+host semantics, or None when the scenario needs the host path (inexact
+scaling, unknown flavor-resources).  Decision parity with the host
+greedy+fillback search is enforced by tests/test_preemption_kernel.py.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import numpy as np
+
+from ..api.types import (
+    IN_CLUSTER_QUEUE_REASON,
+    IN_COHORT_RECLAIM_WHILE_BORROWING_REASON,
+    IN_COHORT_RECLAMATION_REASON,
+)
+from .packing import pack_cycle
+from .preemption_kernel import minimal_preemptions
+
+
+def _bucket(n: int, minimum: int = 8) -> int:
+    b = minimum
+    while b < n:
+        b *= 2
+    return b
+
+
+def device_minimal_preemptions(ctx, candidates, allow_borrowing: bool,
+                               threshold: Optional[int]):
+    """Device twin of Preemptor._minimal_preemptions.
+
+    Returns a list of Targets, [] (search failed), or None (unsupported —
+    run the host path)."""
+    from ..scheduler.preemption import Target  # circular-safe import
+
+    if not candidates:
+        return []
+    packed = pack_cycle(ctx.snapshot, [])
+    if not packed.exact:
+        return None
+    cq_idx = {n: i for i, n in enumerate(packed.cq_names)}
+    pre_cq = cq_idx.get(ctx.preemptor_cq.name)
+    if pre_cq is None:
+        return None
+    F = packed.usage0.shape[1]
+    scale_of = {r: int(packed.resource_scale[i])
+                for i, r in enumerate(packed.resource_names)}
+
+    def to_f_vec(frq) -> Optional[np.ndarray]:
+        vec = np.zeros(F, dtype=np.int64)
+        for fr, v in frq.items():
+            fi = packed.fr_index.get(fr)
+            if fi is None:
+                return None
+            s = scale_of[fr.resource]
+            if v % s:
+                return None
+            vec[fi] += v // s
+        if vec.max(initial=0) > 2**31 - 1:
+            return None
+        return vec.astype(np.int32)
+
+    wl_usage = to_f_vec(ctx.workload_usage)
+    if wl_usage is None:
+        return None
+    frs_mask = np.zeros(F, dtype=bool)
+    for fr in ctx.frs_need_preemption:
+        fi = packed.fr_index.get(fr)
+        if fi is None:
+            return None
+        frs_mask[fi] = True
+
+    K = _bucket(len(candidates))
+    cand_cq = np.full(K, -1, dtype=np.int32)
+    cand_delta = np.zeros((K, F), dtype=np.int32)
+    cand_other = np.zeros(K, dtype=bool)
+    cand_above = np.zeros(K, dtype=bool)
+    for i, cand in enumerate(candidates):
+        ci = cq_idx.get(cand.cluster_queue)
+        if ci is None:
+            return None
+        delta = to_f_vec(cand.usage())
+        if delta is None:
+            return None
+        cand_cq[i] = ci
+        cand_delta[i] = delta
+        cand_other[i] = cand.cluster_queue != ctx.preemptor_cq.name
+        cand_above[i] = (threshold is not None
+                         and cand.obj.priority >= threshold)
+
+    fitted, target_mask = minimal_preemptions(
+        packed.usage0, packed.subtree_quota, packed.guaranteed,
+        packed.borrow_cap, packed.has_borrow_limit, packed.parent,
+        pre_cq, wl_usage, frs_mask, cand_cq, cand_delta, cand_other,
+        cand_above, allow_borrowing, threshold is not None,
+        depth=packed.depth)
+    if not bool(fitted):
+        return []
+    mask = np.asarray(target_mask)
+    targets = []
+    for i, cand in enumerate(candidates):
+        if not mask[i]:
+            continue
+        if not cand_other[i]:
+            reason = IN_CLUSTER_QUEUE_REASON
+        elif threshold is not None and cand.obj.priority < threshold:
+            reason = IN_COHORT_RECLAIM_WHILE_BORROWING_REASON
+        else:
+            reason = IN_COHORT_RECLAMATION_REASON
+        targets.append(Target(info=cand, reason=reason))
+    return targets

@@ -115,6 +115,10 @@ class Preemptor:
         # Pluggable apply hook (reference OverrideApply, preemption.go:96):
         # called with (target Info, reason, message) when issuing evictions.
         self.apply_preemption: Optional[Callable[[Info, str, str], None]] = None
+        # Run the minimal-preemptions search on device (falls back to the
+        # host greedy+fillback when the scenario is unsupported).
+        self.device_search = False
+        self.stats = {"device_searches": 0, "host_searches": 0}
 
     # ------------------------------------------------------------------
     # Target selection — reference preemption.go:127-191
@@ -246,6 +250,15 @@ class Preemptor:
                              allow_borrowing: bool,
                              allow_borrowing_below_priority: Optional[int]
                              ) -> list[Target]:
+        if self.device_search:
+            from ..ops.preemption_solver import device_minimal_preemptions
+            result = device_minimal_preemptions(
+                ctx, candidates, allow_borrowing,
+                allow_borrowing_below_priority)
+            if result is not None:
+                self.stats["device_searches"] += 1
+                return result
+        self.stats["host_searches"] += 1
         targets: list[Target] = []
         fits = False
         for cand in candidates:

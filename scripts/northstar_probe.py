@@ -48,7 +48,7 @@ def synth(W=100_000, C=1_000, S=4, R=3, cohorts=64, seed=0):
     wl_requests = rng.integers(1, 16, (W, R)).astype(np.int32) * 500
     wl_priority = rng.integers(0, 100, W).astype(np.int32)
     wl_timestamp = rng.random(W).astype(np.float64)
-    depth = 1
+    depth = 2      # chain node count: CQ -> cohort
     return (usage0, subtree, guaranteed, borrow_cap, has_blim, parent,
             nominal, slot_fr, slot_valid, can_preempt,
             wl_cq, wl_requests, wl_priority, wl_timestamp), depth

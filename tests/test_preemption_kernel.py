@@ -1,0 +1,135 @@
+"""Device preemption-search parity: the lax.scan minimalPreemptions twin
+must pick the same targets as the host greedy+fillback
+(reference preemption.go:275-342)."""
+
+import random
+
+import pytest
+
+from kueue_tpu.api.types import (
+    BorrowWithinCohort,
+    BorrowWithinCohortPolicy,
+    ClusterQueue,
+    FlavorQuotas,
+    LocalQueue,
+    PodSet,
+    PreemptionPolicy,
+    ReclaimWithinCohort,
+    ResourceFlavor,
+    ResourceGroup,
+    ResourceQuota,
+    WithinClusterQueue,
+    Workload,
+)
+from kueue_tpu.controller.driver import Driver
+
+
+class FakeClock:
+    def __init__(self, now=1000.0):
+        self.t = now
+
+    def __call__(self):
+        return self.t
+
+
+def build_preemption_driver(seed, device_search, n_cqs=4, n_low=10):
+    """Cohort with borrowing CQs full of low-priority admitted workloads,
+    then high-priority arrivals that must preempt/reclaim."""
+    rng = random.Random(seed)
+    clock = FakeClock()
+    d = Driver(clock=clock)
+    d.scheduler.preemptor.device_search = device_search
+    d.apply_resource_flavor(ResourceFlavor(name="default"))
+    for i in range(n_cqs):
+        d.apply_cluster_queue(ClusterQueue(
+            name=f"cq-{i}", cohort="team",
+            preemption=PreemptionPolicy(
+                reclaim_within_cohort=ReclaimWithinCohort.ANY,
+                within_cluster_queue=WithinClusterQueue.LOWER_PRIORITY,
+                borrow_within_cohort=BorrowWithinCohort(
+                    policy=BorrowWithinCohortPolicy.LOWER_PRIORITY,
+                    max_priority_threshold=50)
+                if i % 2 == 0 else BorrowWithinCohort()),
+            resource_groups=[ResourceGroup(
+                covered_resources=["cpu"],
+                flavors=[FlavorQuotas(name="default", resources={
+                    "cpu": ResourceQuota(nominal=4000,
+                                         borrowing_limit=8000)})])]))
+        d.apply_local_queue(LocalQueue(name=f"lq-{i}",
+                                       cluster_queue=f"cq-{i}"))
+    # fill with low-priority workloads (some borrow)
+    for k in range(n_low):
+        q = rng.randrange(n_cqs)
+        d.create_workload(Workload(
+            name=f"low-{k}", queue_name=f"lq-{q}",
+            priority=rng.choice([0, 10, 20, 60]),
+            creation_time=float(k + 1),
+            pod_sets=[PodSet(name="main", count=1,
+                             requests={"cpu": rng.choice([1000, 2000])})]))
+    d.run_until_settled()
+    # high-priority arrivals needing preemption
+    for k in range(n_cqs):
+        d.create_workload(Workload(
+            name=f"high-{k}", queue_name=f"lq-{k}", priority=100,
+            creation_time=100.0 + k,
+            pod_sets=[PodSet(name="main", count=1,
+                             requests={"cpu": 3000})]))
+    clock.t += 10.0
+    d.run_until_settled()
+    return d
+
+
+@pytest.mark.parametrize("seed", [1, 2, 3, 4, 5])
+def test_device_preemption_search_matches_host(seed):
+    results = []
+    for device in (False, True):
+        d = build_preemption_driver(seed, device)
+        admitted = frozenset(d.admitted_keys())
+        evicted = frozenset(
+            k for k, wl in d.workloads.items()
+            if wl.conditions.get("Evicted") is not None)
+        results.append((admitted, evicted, d))
+    (h_adm, h_ev, _), (d_adm, d_ev, d_dev) = results
+    assert h_adm == d_adm
+    assert h_ev == d_ev
+    assert d_dev.scheduler.preemptor.stats["device_searches"] >= 1, \
+        d_dev.scheduler.preemptor.stats
+
+
+def test_device_search_stats_fallback_for_fair_sharing():
+    # fair-sharing preemption stays on host
+    clock = FakeClock()
+    d = Driver(clock=clock, fair_sharing=True)
+    d.scheduler.preemptor.device_search = True
+    d.apply_resource_flavor(ResourceFlavor(name="default"))
+    d.apply_cluster_queue(ClusterQueue(
+        name="cq-a", cohort="team",
+        preemption=PreemptionPolicy(
+            reclaim_within_cohort=ReclaimWithinCohort.ANY),
+        resource_groups=[ResourceGroup(
+            covered_resources=["cpu"],
+            flavors=[FlavorQuotas(name="default", resources={
+                "cpu": ResourceQuota(nominal=2000,
+                                     borrowing_limit=2000)})])]))
+    d.apply_cluster_queue(ClusterQueue(
+        name="cq-b", cohort="team",
+        resource_groups=[ResourceGroup(
+            covered_resources=["cpu"],
+            flavors=[FlavorQuotas(name="default", resources={
+                "cpu": ResourceQuota(nominal=2000,
+                                     borrowing_limit=2000)})])]))
+    for q in ("a", "b"):
+        d.apply_local_queue(LocalQueue(name=f"lq-{q}",
+                                       cluster_queue=f"cq-{q}"))
+    d.create_workload(Workload(
+        name="borrower", queue_name="lq-b", creation_time=1.0,
+        pod_sets=[PodSet(name="main", count=1, requests={"cpu": 4000})]))
+    d.run_until_settled()
+    d.create_workload(Workload(
+        name="reclaimer", queue_name="lq-a", creation_time=2.0,
+        pod_sets=[PodSet(name="main", count=1, requests={"cpu": 2000})]))
+    clock.t += 1.0
+    d.run_until_settled()
+    # fair-sharing path never reaches the device search
+    assert d.scheduler.preemptor.stats["device_searches"] == 0
+    assert "default/reclaimer" in d.admitted_keys()
