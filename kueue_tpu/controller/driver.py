@@ -102,6 +102,40 @@ class Driver:
         self.metrics = metrics.Registry()
         self.scheduler.metrics = self.metrics
 
+    @classmethod
+    def from_config(cls, cfg, clock: Callable[[], float] = time.time,
+                    **kw) -> "Driver":
+        """Build a driver from a Configuration (reference cmd/kueue/main.go
+        :123-144 config→wiring + feature-gate application)."""
+        from .. import features
+        from ..workload import ResourceTransformation as _RT
+        if cfg.feature_gates:
+            features.set_feature_gates(cfg.feature_gates)
+        w = cfg.wait_for_pods_ready
+        wfpr = WaitForPodsReadyConfig(
+            enable=w.enable,
+            timeout_seconds=w.timeout_seconds,
+            block_admission=w.block_admission,
+            requeuing_backoff_base_seconds=(
+                w.requeuing_strategy.backoff_base_seconds),
+            requeuing_backoff_max_seconds=(
+                w.requeuing_strategy.backoff_max_seconds),
+            requeuing_backoff_limit_count=(
+                w.requeuing_strategy.backoff_limit_count),
+            requeuing_timestamp=w.requeuing_strategy.timestamp)
+        info_options = InfoOptions(
+            excluded_prefixes=list(cfg.resources.exclude_resource_prefixes),
+            transformations={
+                t.input: _RT(input=t.input, strategy=t.strategy,
+                             outputs=dict(t.outputs))
+                for t in cfg.resources.transformations})
+        return cls(clock=clock,
+                   fair_sharing=cfg.fair_sharing.enable,
+                   fs_preemption_strategies=list(
+                       cfg.fair_sharing.preemption_strategies),
+                   info_options=info_options,
+                   wait_for_pods_ready=wfpr, **kw)
+
     # ------------------------------------------------------------------
     # Resource plumbing (reconciler-equivalents)
     # ------------------------------------------------------------------

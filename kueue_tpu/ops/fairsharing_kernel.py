@@ -1,0 +1,94 @@
+"""Batched fair-sharing DRS (SURVEY §7 stage 6).
+
+``drs_components`` computes, for every node at once, the two tensors the
+DominantResourceShare needs (reference fair_sharing.go:47-82
+dominantResourceShare + calculateLendable): borrowed-above-subtree-quota
+per (node, resource) and the parent's lendable capacity per
+(node, resource) — one one-hot matmul over [N, F] instead of a per-CQ
+tree walk.  The final exact int64 ratio/weight division happens host-side
+(``compute_all_drs``), keeping the kernel int32/TPU-native.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..cache.state import MAX_DRS
+from .quota_kernel import available_all
+
+
+@partial(jax.jit, static_argnames=("depth", "n_resources"))
+def drs_components(usage, subtree, guaranteed, borrow_cap, has_blim, parent,
+                   fr_to_resource, wl_req=None, *,
+                   n_resources: int, depth: int):
+    """Returns (borrowing [N,R], lendable [N,R]) int32.
+
+    fr_to_resource: [F] int32 mapping flavor-resource columns to resource
+    index; wl_req: optional [N, F] additive usage (the fair-sharing
+    iterator's computeDRS adds the entry's usage to its CQ)."""
+    onehot = jax.nn.one_hot(fr_to_resource, n_resources, dtype=usage.dtype)
+
+    total_usage = usage if wl_req is None else usage + wl_req
+    borrowed = jnp.maximum(0, total_usage - subtree)                # [N, F]
+    borrowing_r = borrowed @ onehot                                 # [N, R]
+
+    # lendable: potentialAvailable of each node's parent, summed per
+    # resource (calculateLendable, fair_sharing.go:86)
+    potential = available_all(jnp.zeros_like(usage), subtree, guaranteed,
+                              borrow_cap, has_blim, parent, depth)
+    lendable_all = potential @ onehot
+    parent_safe = jnp.maximum(parent, 0)
+    lendable_r = jnp.where((parent >= 0)[:, None],
+                           lendable_all[parent_safe], 0)            # [N, R]
+    return borrowing_r, lendable_r
+
+
+def compute_all_drs(snapshot) -> dict[str, int]:
+    """DRS for every ClusterQueue and cohort in one device pass; parity
+    with cache.state.dominant_resource_share (requires exact packing)."""
+    from .packing import PackedCycle, _iter_nodes, pack_cycle
+    packed = pack_cycle(snapshot, [])
+    r_idx = {r: i for i, r in enumerate(packed.resource_names)}
+    F = packed.usage0.shape[1]
+    fr_to_resource = np.zeros(F, dtype=np.int32)
+    for fr, fi in packed.fr_index.items():
+        fr_to_resource[fi] = r_idx[fr.resource]
+    borrowing, lendable = drs_components(
+        packed.usage0, packed.subtree_quota, packed.guaranteed,
+        packed.borrow_cap, packed.has_borrow_limit, packed.parent,
+        fr_to_resource, n_resources=len(packed.resource_names),
+        depth=packed.depth)
+    borrowing = np.asarray(borrowing, dtype=np.int64)
+    lendable = np.asarray(lendable, dtype=np.int64)
+    # per-resource scaling cancels in the ratio only for exact packs;
+    # scale back up to raw units to keep host parity regardless
+    scale = packed.resource_scale.astype(np.int64)                  # [R]
+    borrowing *= scale[None, :]
+    lendable *= scale[None, :]
+
+    _, cohorts = _iter_nodes(snapshot)
+    names = list(packed.cq_names) + [c.name for c in cohorts]
+    weights = packed.fair_weight_milli
+    parent = packed.parent
+    out: dict[str, int] = {}
+    for i, name in enumerate(names):
+        if parent[i] < 0:
+            out[name] = 0
+            continue
+        if weights[i] == 0:
+            out[name] = MAX_DRS
+            continue
+        if not (borrowing[i] > 0).any():
+            out[name] = 0       # not borrowing at all (fair_sharing.go:63)
+            continue
+        drs = -1
+        for r in range(borrowing.shape[1]):
+            if borrowing[i, r] > 0 and lendable[i, r] > 0:
+                drs = max(drs, int(borrowing[i, r]) * 1000
+                          // int(lendable[i, r]))
+        out[name] = drs * 1000 // int(weights[i])
+    return out

@@ -64,6 +64,7 @@ class PackedCycle:
     wl_timestamp: np.ndarray             # [W] float64 queue-order timestamp
     wl_keys: list[str] = field(default_factory=list)
     exact: bool = True                   # scaled comparisons are lossless
+    fair_weight_milli: np.ndarray = None  # [N] int32 (fair sharing)
 
 
 def _bucket(n: int, minimum: int = 8) -> int:
@@ -197,9 +198,11 @@ def pack_cycle(snapshot: Snapshot, heads: list[Info],
     parent = np.full(N, -1, dtype=np.int32)
     nominal_cq = np.zeros((C, F), dtype=np.int32)
 
+    fair_weight = np.full(N, 1000, dtype=np.int32)
     for ni, node in enumerate(nodes):
         p = node.parent
         parent[ni] = cohort_idx[id(p)] if p is not None else -1
+        fair_weight[ni] = getattr(node, "fair_weight_milli", 1000)
         rn = node.resource_node
         for fr, fi in fr_index.items():
             sq = rn.subtree_quota.get(fr, 0)
@@ -282,5 +285,5 @@ def pack_cycle(snapshot: Snapshot, heads: list[Info],
         cq_can_preempt_borrow=cq_can_preempt_borrow,
         wl_count=len(heads), wl_cq=wl_cq, wl_requests=wl_requests,
         wl_priority=wl_priority, wl_timestamp=wl_timestamp, wl_keys=wl_keys,
-        exact=exact,
+        exact=exact, fair_weight_milli=fair_weight,
     )

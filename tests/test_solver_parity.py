@@ -214,3 +214,45 @@ def test_device_solver_charges_pods_quota():
     fr_pods = FlavorResource("default", "pods")
     cq = d.cache.snapshot().cq("cq")
     assert cq.resource_node.usage.get(fr_pods, 0) == 2
+
+
+def test_drs_kernel_matches_host():
+    """Batched DRS components vs cache.state.dominant_resource_share."""
+    from kueue_tpu.api.types import FairSharing
+    from kueue_tpu.ops.fairsharing_kernel import compute_all_drs
+
+    rng = random.Random(99)
+    clock = FakeClock()
+    d = Driver(clock=clock, fair_sharing=True)
+    d.apply_resource_flavor(ResourceFlavor(name="f0"))
+    for i in range(6):
+        d.apply_cluster_queue(ClusterQueue(
+            name=f"cq-{i}", cohort=["team-a", "team-b"][i % 2],
+            fair_sharing=FairSharing(weight=[1.0, 2.0, 0.5][i % 3]),
+            resource_groups=[ResourceGroup(
+                covered_resources=["cpu"],
+                flavors=[FlavorQuotas(name="f0", resources={
+                    "cpu": ResourceQuota(nominal=2000,
+                                         borrowing_limit=8000)})])]))
+        d.apply_local_queue(LocalQueue(name=f"lq-{i}",
+                                       cluster_queue=f"cq-{i}"))
+    for k in range(20):
+        q = rng.randrange(6)
+        d.create_workload(Workload(
+            name=f"wl-{k}", queue_name=f"lq-{q}",
+            creation_time=float(k + 1),
+            pod_sets=[PodSet(name="main", count=1,
+                             requests={"cpu": rng.choice([500, 1000, 1500])})]))
+    d.run_until_settled()
+    snapshot = d.cache.snapshot()
+    device = compute_all_drs(snapshot)
+    borrowing_cqs = 0
+    for name, dev_drs in device.items():
+        node = snapshot.cq(name)
+        if node is None:
+            continue  # cohorts checked implicitly via CQ coverage
+        host_drs, _ = node.dominant_resource_share()
+        assert dev_drs == host_drs, (name, dev_drs, host_drs)
+        if host_drs > 0:
+            borrowing_cqs += 1
+    assert borrowing_cqs >= 1, "scenario produced no borrowing CQ"
