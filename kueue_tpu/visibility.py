@@ -96,6 +96,63 @@ class VisibilityService:
         return out
 
 
+_DASHBOARD_HTML = """<!doctype html>
+<html><head><meta charset="utf-8"><title>kueue-tpu</title>
+<style>
+ body{font-family:system-ui,sans-serif;margin:2rem;color:#222}
+ table{border-collapse:collapse;min-width:40rem}
+ th,td{border:1px solid #ccc;padding:.35rem .7rem;text-align:left}
+ th{background:#f5f5f5}
+ .inactive{color:#b00}
+ code{background:#f5f5f5;padding:0 .3rem}
+</style></head><body>
+<h1>kueue-tpu</h1>
+<p>Cluster queues (auto-refreshes; endpoints:
+<code>/apis/visibility/v1beta1/…</code>, <code>/metrics</code>)</p>
+<table id="cqs"><thead><tr>
+<th>ClusterQueue</th><th>Status</th><th>Pending</th><th>Usage</th>
+</tr></thead><tbody></tbody></table>
+<h2>Pending workloads</h2>
+<table id="pending"><thead><tr>
+<th>#</th><th>Workload</th><th>LocalQueue</th><th>Priority</th>
+<th>ClusterQueue</th>
+</tr></thead><tbody></tbody></table>
+<script>
+async function refresh(){
+  const r = await fetch('/apis/visibility/v1beta1/clusterqueues');
+  const cqs = await r.json();
+  const body = document.querySelector('#cqs tbody');
+  body.innerHTML = '';
+  const pbody = document.querySelector('#pending tbody');
+  pbody.innerHTML = '';
+  for (const [name, info] of Object.entries(cqs)) {
+    const tr = document.createElement('tr');
+    tr.innerHTML = `<td>${name}</td>` +
+      `<td class="${info.active ? '' : 'inactive'}">` +
+      `${info.active ? 'active' : 'inactive'}</td>` +
+      `<td>${info.pending}</td>` +
+      `<td><code>${JSON.stringify(info.usage)}</code></td>`;
+    body.appendChild(tr);
+    if (info.pending > 0) {
+      const pr = await fetch('/apis/visibility/v1beta1/clusterqueues/' +
+                             name + '/pendingworkloads');
+      const items = (await pr.json()).items;
+      for (const w of items) {
+        const tr2 = document.createElement('tr');
+        tr2.innerHTML = `<td>${w.position_in_cluster_queue}</td>` +
+          `<td>${w.namespace}/${w.name}</td>` +
+          `<td>${w.local_queue_name}</td><td>${w.priority}</td>` +
+          `<td>${name}</td>`;
+        pbody.appendChild(tr2);
+      }
+    }
+  }
+}
+refresh(); setInterval(refresh, 3000);
+</script></body></html>
+"""
+
+
 class VisibilityServer:
     """The aggregated-API-server equivalent: a real HTTP endpoint
     (reference visibility/server.go:62 + kueueviz backend)."""
@@ -117,6 +174,18 @@ class VisibilityServer:
                 pass
 
             def do_GET(self):
+                if self.path.split("?")[0] in ("/", "/index.html"):
+                    # kueueviz-equivalent dashboard (reference
+                    # cmd/kueueviz): live CQ table fed by the visibility
+                    # endpoints below
+                    payload = _DASHBOARD_HTML.encode()
+                    self.send_response(200)
+                    self.send_header("Content-Type",
+                                     "text/html; charset=utf-8")
+                    self.send_header("Content-Length", str(len(payload)))
+                    self.end_headers()
+                    self.wfile.write(payload)
+                    return
                 if self.path.split("?")[0] == "/metrics":
                     # Prometheus exposition (reference secure metrics
                     # endpoint, cmd/kueue/main.go:154-179)

@@ -273,3 +273,37 @@ def test_metrics_http_endpoint():
         assert "kueue_cluster_queue_resource_nominal_quota" in body
     finally:
         server.stop()
+
+
+def test_dashboard_page_served():
+    d = make_driver_with_pending()
+    server = VisibilityServer(d)
+    port = server.start()
+    try:
+        body = urllib.request.urlopen(
+            f"http://127.0.0.1:{port}/", timeout=5).read().decode()
+        assert "kueue-tpu" in body and "clusterqueues" in body
+    finally:
+        server.stop()
+
+
+def test_driver_from_config():
+    from kueue_tpu.config import Configuration, FairSharingConfig, \
+        ResourcesConfig, ResourceTransformation, WaitForPodsReady
+    from kueue_tpu.controller.driver import Driver
+    cfg = Configuration(
+        fair_sharing=FairSharingConfig(enable=True),
+        wait_for_pods_ready=WaitForPodsReady(enable=True,
+                                             timeout_seconds=60.0),
+        resources=ResourcesConfig(
+            exclude_resource_prefixes=["example.com/"],
+            transformations=[ResourceTransformation(
+                input="nvidia.com/mig-1g.5gb", strategy="Replace",
+                outputs={"example.org/mem": 5})]))
+    d = Driver.from_config(cfg, clock=lambda: 1000.0)
+    assert d.scheduler.fair_sharing
+    assert d.wait_for_pods_ready.enable
+    assert d.wait_for_pods_ready.timeout_seconds == 60.0
+    opts = d.cache.info_options
+    assert opts.excluded_prefixes == ["example.com/"]
+    assert "nvidia.com/mig-1g.5gb" in opts.transformations
