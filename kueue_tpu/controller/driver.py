@@ -170,6 +170,19 @@ class Driver:
         self.queues.queue_inadmissible_workloads([spec.name])
         self.metrics.cluster_queue_status(spec.name,
                                           self.cache.cluster_queue(spec.name).active)
+        if spec.stop_policy == StopPolicy.HOLD_AND_DRAIN:
+            self._drain_cluster_queue(spec.name)
+
+    def _drain_cluster_queue(self, cq_name: str) -> None:
+        """HoldAndDrain evicts admitted workloads (reference
+        workload_controller.go:466 ClusterQueueStopped eviction)."""
+        from ..api.types import EVICTED_BY_CQ_STOPPED
+        for key, wl in list(self.workloads.items()):
+            if (wl.admission is not None
+                    and wl.admission.cluster_queue == cq_name
+                    and wl.has_quota_reservation and not wl.is_finished):
+                self._evict(wl, EVICTED_BY_CQ_STOPPED,
+                            f"ClusterQueue {cq_name} is stopped")
 
     def delete_cluster_queue(self, name: str) -> None:
         self.cache.delete_cluster_queue(name)
@@ -187,6 +200,15 @@ class Driver:
             webhooks.validate_local_queue(lq)
         self.cache.add_or_update_local_queue(lq)
         self.queues.add_local_queue(lq)
+        if lq.stop_policy == StopPolicy.HOLD_AND_DRAIN:
+            from ..api.types import EVICTED_BY_LQ_STOPPED
+            for key, wl in list(self.workloads.items()):
+                if (wl.namespace == lq.namespace
+                        and wl.queue_name == lq.name
+                        and wl.has_quota_reservation
+                        and not wl.is_finished):
+                    self._evict(wl, EVICTED_BY_LQ_STOPPED,
+                                f"LocalQueue {lq.name} is stopped")
 
     def _sync_cq_activeness(self) -> None:
         for name in self.cache.cluster_queue_names():

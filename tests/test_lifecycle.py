@@ -1,0 +1,196 @@
+"""Workload-lifecycle scenarios mirroring reference
+pkg/controller/core/workload_controller.go and the
+test/integration/singlecluster/scheduler/podsready suites:
+WaitForPodsReady eviction + exponential backoff + deactivation, stop
+policies (Hold / HoldAndDrain), namespace selectors, maximum execution
+time, and cohort-level quotas."""
+
+import pytest
+
+from kueue_tpu.api.types import (
+    ClusterQueue,
+    Cohort,
+    FlavorQuotas,
+    LocalQueue,
+    PodSet,
+    ResourceFlavor,
+    ResourceGroup,
+    ResourceQuota,
+    StopPolicy,
+    Workload,
+    WL_EVICTED,
+)
+from kueue_tpu.controller.driver import Driver, WaitForPodsReadyConfig
+
+
+class FakeClock:
+    def __init__(self, now=1000.0):
+        self.t = now
+
+    def __call__(self):
+        return self.t
+
+    def tick(self, dt=1.0):
+        self.t += dt
+
+
+def simple_cq(name, cohort=None, nominal=10_000, stop=StopPolicy.NONE,
+              namespace_selector=None):
+    return ClusterQueue(
+        name=name, cohort=cohort, stop_policy=stop,
+        namespace_selector=namespace_selector,
+        resource_groups=[ResourceGroup(
+            covered_resources=["cpu"],
+            flavors=[FlavorQuotas(name="default", resources={
+                "cpu": ResourceQuota(nominal=nominal)})])])
+
+
+def wl(name, cpu=1000, queue="lq", created=1.0, namespace="default", **kw):
+    return Workload(name=name, queue_name=queue, creation_time=created,
+                    namespace=namespace,
+                    pod_sets=[PodSet(name="main", count=1,
+                                     requests={"cpu": cpu})], **kw)
+
+
+def make_driver(clock=None, **kw):
+    d = Driver(clock=clock or FakeClock(), **kw)
+    d.apply_resource_flavor(ResourceFlavor(name="default"))
+    return d
+
+
+def test_pods_ready_timeout_evicts_with_backoff_then_deactivates():
+    clock = FakeClock()
+    d = make_driver(clock, wait_for_pods_ready=WaitForPodsReadyConfig(
+        enable=True, timeout_seconds=30.0,
+        requeuing_backoff_base_seconds=10,
+        requeuing_backoff_limit_count=2))
+    d.apply_cluster_queue(simple_cq("cq"))
+    d.apply_local_queue(LocalQueue(name="lq", cluster_queue="cq"))
+    d.create_workload(wl("slow"))
+    d.run_until_settled()
+    assert "default/slow" in d.admitted_keys()
+
+    # pods never ready → timeout eviction with requeue backoff
+    clock.tick(31.0)
+    d.evict_for_pods_ready_timeout("default/slow")
+    w = d.workload("default/slow")
+    assert w.is_evicted and w.requeue_state.count == 1
+    assert w.requeue_state.requeue_at > clock()
+    d.run_until_settled()
+    assert "default/slow" not in d.admitted_keys()   # backoff gates requeue
+
+    clock.tick(11.0)                                  # backoff expired
+    d.queues.queue_inadmissible_workloads(["cq"])
+    d.run_until_settled()
+    assert "default/slow" in d.admitted_keys()        # re-admitted
+
+    clock.tick(31.0)
+    d.evict_for_pods_ready_timeout("default/slow")
+    assert d.workload("default/slow").requeue_state.count == 2
+    clock.tick(25.0)
+    d.queues.queue_inadmissible_workloads(["cq"])
+    d.run_until_settled()
+    clock.tick(31.0)
+    d.evict_for_pods_ready_timeout("default/slow")
+    # third strike exceeds backoffLimitCount → deactivated
+    assert not d.workload("default/slow").is_active
+
+
+def test_cq_hold_and_drain_evicts_admitted():
+    clock = FakeClock()
+    d = make_driver(clock)
+    d.apply_cluster_queue(simple_cq("cq"))
+    d.apply_local_queue(LocalQueue(name="lq", cluster_queue="cq"))
+    d.create_workload(wl("running"))
+    d.run_until_settled()
+    assert "default/running" in d.admitted_keys()
+
+    d.apply_cluster_queue(simple_cq("cq", stop=StopPolicy.HOLD_AND_DRAIN))
+    w = d.workload("default/running")
+    assert w.is_evicted
+    assert w.conditions[WL_EVICTED].reason == "ClusterQueueStopped"
+    d.run_until_settled()
+    assert d.admitted_keys() == set()                 # held: no re-admission
+
+    d.apply_cluster_queue(simple_cq("cq"))            # resume
+    d.run_until_settled()
+    assert "default/running" in d.admitted_keys()
+
+
+def test_cq_hold_keeps_admitted_but_blocks_new():
+    clock = FakeClock()
+    d = make_driver(clock)
+    d.apply_cluster_queue(simple_cq("cq", nominal=2000))
+    d.apply_local_queue(LocalQueue(name="lq", cluster_queue="cq"))
+    d.create_workload(wl("first"))
+    d.run_until_settled()
+    d.apply_cluster_queue(simple_cq("cq", nominal=2000,
+                                    stop=StopPolicy.HOLD))
+    d.create_workload(wl("second", created=2.0))
+    d.run_until_settled()
+    assert d.admitted_keys() == {"default/first"}     # kept, no new
+
+
+def test_lq_hold_and_drain():
+    clock = FakeClock()
+    d = make_driver(clock)
+    d.apply_cluster_queue(simple_cq("cq"))
+    d.apply_local_queue(LocalQueue(name="lq", cluster_queue="cq"))
+    d.create_workload(wl("running"))
+    d.run_until_settled()
+    d.apply_local_queue(LocalQueue(name="lq", cluster_queue="cq",
+                                   stop_policy=StopPolicy.HOLD_AND_DRAIN))
+    w = d.workload("default/running")
+    assert w.is_evicted
+    assert w.conditions[WL_EVICTED].reason == "LocalQueueStopped"
+
+
+def test_namespace_selector():
+    clock = FakeClock()
+    d = make_driver(clock, namespaces={
+        "team-a": {"tier": "prod"}, "team-b": {"tier": "dev"}})
+    d.apply_cluster_queue(simple_cq(
+        "cq", namespace_selector={"tier": "prod"}))
+    for ns in ("team-a", "team-b"):
+        d.apply_local_queue(LocalQueue(name="lq", namespace=ns,
+                                       cluster_queue="cq"))
+    d.create_workload(wl("allowed", namespace="team-a"))
+    d.create_workload(wl("denied", namespace="team-b", created=2.0))
+    d.run_until_settled()
+    assert d.admitted_keys() == {"team-a/allowed"}
+
+
+def test_maximum_execution_time_deactivates():
+    clock = FakeClock()
+    d = make_driver(clock)
+    d.apply_cluster_queue(simple_cq("cq"))
+    d.apply_local_queue(LocalQueue(name="lq", cluster_queue="cq"))
+    d.create_workload(wl("bounded", maximum_execution_time_seconds=60))
+    d.run_until_settled()
+    assert "default/bounded" in d.admitted_keys()
+    clock.tick(30.0)
+    assert d.check_maximum_execution_times() == []
+    clock.tick(31.0)
+    assert d.check_maximum_execution_times() == ["default/bounded"]
+    assert not d.workload("default/bounded").is_active
+
+
+def test_cohort_level_quota_caps_borrowing():
+    """KEP 79: a cohort with its own quota caps what its subtree can use
+    beyond CQ nominals."""
+    clock = FakeClock()
+    d = make_driver(clock)
+    d.apply_cohort(Cohort(name="team", resource_groups=[ResourceGroup(
+        covered_resources=["cpu"],
+        flavors=[FlavorQuotas(name="default", resources={
+            "cpu": ResourceQuota(nominal=1000)})])]))
+    d.apply_cluster_queue(simple_cq("cq-a", cohort="team", nominal=1000))
+    d.apply_cluster_queue(simple_cq("cq-b", cohort="team", nominal=1000))
+    d.apply_local_queue(LocalQueue(name="lq-a", cluster_queue="cq-a"))
+    d.apply_local_queue(LocalQueue(name="lq-b", cluster_queue="cq-b"))
+    # cq-a can use its 1000 + borrow the cohort's extra 1000 + cq-b's idle
+    for i in range(4):
+        d.create_workload(wl(f"a{i}", queue="lq-a", created=float(i + 1)))
+    d.run_until_settled()
+    # subtree capacity = 1000(cohort) + 1000 + 1000 = 3000 → 3 admitted
+    assert d.admitted_keys() == {"default/a0", "default/a1", "default/a2"}
