@@ -231,6 +231,24 @@ class Cache:
     def cluster_queue_names(self) -> list[str]:
         return list(self._mgr.cluster_queues)
 
+    def local_queue_usage(self, namespace: str, lq_name: str
+                          ) -> FlavorResourceQuantities:
+        """Usage aggregated over a LocalQueue's admitted workloads
+        (reference cache.go:786 LocalQueueUsage)."""
+        out = FlavorResourceQuantities()
+        lq = self.local_queues.get(f"{namespace}/{lq_name}")
+        if lq is None:
+            return out
+        cq = self._mgr.cluster_queues.get(lq.cluster_queue)
+        if cq is None:
+            return out
+        for info in cq.workloads.values():
+            wl = info.obj
+            if wl.namespace == namespace and wl.queue_name == lq_name:
+                for fr, v in info.usage().items():
+                    out[fr] = out.get(fr, 0) + v
+        return out
+
     def cohort_state(self, name: str) -> Optional[CohortState]:
         node = self._mgr.cohort(name)
         return node.payload if node else None

@@ -173,12 +173,22 @@ class TASFlavorSnapshot:
         return (-dom.state, dom.id)
 
     def _find_fit_at(self, level: int, count: int) -> tuple[int, Optional[Domain]]:
-        """Best single domain at `level` that fits all pods: the one with the
-        least spare capacity (BestFit), ties by id."""
+        """Best single domain at `level` that fits all pods.
+
+        Default BestFit: least spare capacity, ties by id; the
+        TASProfileMostFreeCapacity gate flips to most-free (reference
+        tas_flavor_snapshot.go:551-568 profile selection)."""
+        from .. import features
+        most_free = features.enabled("TASProfileMostFreeCapacity")
         best = None
         for dom in self.domains_per_level[level]:
             if dom.state >= count:
-                if best is None or (dom.state, dom.id) < (best.state, best.id):
+                if best is None:
+                    best = dom
+                elif most_free:
+                    if (-dom.state, dom.id) < (-best.state, best.id):
+                        best = dom
+                elif (dom.state, dom.id) < (best.state, best.id):
                     best = dom
         return level, best
 

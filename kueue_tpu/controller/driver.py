@@ -97,6 +97,7 @@ class Driver:
         # durable store: the CRD-status equivalent
         self.workloads: dict[str, Workload] = {}
         self.priority_classes: dict[str, object] = {}
+        self.limit_ranges: dict[str, dict[str, object]] = {}
         self.validate = validate
         self.events: list[tuple[str, str, str]] = []  # (kind, key, note)
         self.metrics = metrics.Registry()
@@ -149,6 +150,14 @@ class Driver:
     def apply_topology(self, topology: Topology) -> None:
         self.cache.add_or_update_topology(topology)
         self._wake_all()
+
+    def apply_limit_range(self, lr) -> None:
+        """Namespace LimitRanges (reference pkg/util/limitrange): defaults
+        applied at workload creation, bounds enforced at nomination."""
+        from ..limitrange import summarize
+        self.limit_ranges.setdefault(lr.namespace, {})[lr.name] = lr
+        self.scheduler.limit_range_summaries[lr.namespace] = summarize(
+            list(self.limit_ranges[lr.namespace].values()))
 
     def apply_workload_priority_class(self, pc) -> None:
         """reference WorkloadPriorityClass (pkg/util/priority)."""
@@ -226,6 +235,11 @@ class Driver:
 
     def create_workload(self, wl: Workload) -> None:
         webhooks.default_workload(wl)
+        summary = self.scheduler.limit_range_summaries.get(wl.namespace)
+        if summary is not None:
+            from ..limitrange import apply_defaults
+            for ps in wl.pod_sets:
+                ps.requests = apply_defaults(ps.requests, summary)
         if self.validate:
             webhooks.validate_workload(wl)
         if wl.creation_time == 0.0:

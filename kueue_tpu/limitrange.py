@@ -1,0 +1,73 @@
+"""LimitRange support (reference pkg/util/limitrange).
+
+Namespace LimitRanges contribute container defaults and min/max bounds;
+``Summary.total_bounds`` validates a workload's per-pod requests the way
+the reference's scheduler nominate step does (scheduler.go:336
+validateResources via limitrange.Summarize)."""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Optional
+
+
+@dataclass
+class LimitRangeItem:
+    type: str = "Container"          # Container | Pod
+    default: dict[str, int] = field(default_factory=dict)
+    min: dict[str, int] = field(default_factory=dict)
+    max: dict[str, int] = field(default_factory=dict)
+
+
+@dataclass
+class LimitRange:
+    name: str
+    namespace: str = "default"
+    items: list[LimitRangeItem] = field(default_factory=list)
+
+
+@dataclass
+class Summary:
+    """reference limitrange.Summarize: per-type combined bounds."""
+    default: dict[str, int] = field(default_factory=dict)
+    min: dict[str, int] = field(default_factory=dict)    # per pod
+    max: dict[str, int] = field(default_factory=dict)
+
+
+def summarize(ranges: list[LimitRange]) -> Summary:
+    s = Summary()
+    for lr in ranges:
+        for item in lr.items:
+            if item.type not in ("Container", "Pod"):
+                continue
+            for r, v in item.default.items():
+                s.default.setdefault(r, v)
+            for r, v in item.min.items():
+                # the tightest (largest) min wins
+                s.min[r] = max(s.min.get(r, v), v)
+            for r, v in item.max.items():
+                s.max[r] = min(s.max.get(r, v), v)
+    return s
+
+
+def apply_defaults(requests: dict[str, int], summary: Summary) -> dict[str, int]:
+    """Fill unset resources from LimitRange defaults (reference
+    jobframework AdjustResources path)."""
+    out = dict(requests)
+    for r, v in summary.default.items():
+        out.setdefault(r, v)
+    return out
+
+
+def validate(requests: dict[str, int], summary: Summary) -> list[str]:
+    """Per-pod request bounds (reference limitrange.ValidatePodSpec)."""
+    errors = []
+    for r, lo in summary.min.items():
+        if r in requests and requests[r] < lo:
+            errors.append(
+                f"request {r}={requests[r]} below LimitRange min {lo}")
+    for r, hi in summary.max.items():
+        if r in requests and requests[r] > hi:
+            errors.append(
+                f"request {r}={requests[r]} above LimitRange max {hi}")
+    return errors

@@ -112,6 +112,8 @@ class Scheduler:
         self.solver = solver
         # Optional metrics registry (set by the driver).
         self.metrics = None
+        # Namespace → limitrange.Summary (set by the driver).
+        self.limit_range_summaries: dict[str, object] = {}
 
     # ------------------------------------------------------------------
     # One cycle — reference scheduler.go:176
@@ -268,10 +270,19 @@ class Scheduler:
         labels = self.namespaces.get(namespace, {})
         return all(labels.get(k) == v for k, v in selector.items())
 
-    @staticmethod
-    def _validate_resources(info: Info) -> bool:
-        return all(v >= 0 for psr in info.total_requests
-                   for v in psr.requests.values())
+    def _validate_resources(self, info: Info) -> bool:
+        """Non-negative totals + namespace LimitRange bounds (reference
+        scheduler.go:336 validateResources via pkg/util/limitrange)."""
+        if not all(v >= 0 for psr in info.total_requests
+                   for v in psr.requests.values()):
+            return False
+        summary = self.limit_range_summaries.get(info.obj.namespace)
+        if summary is not None:
+            from ..limitrange import validate as lr_validate
+            for ps in info.obj.pod_sets:
+                if lr_validate(ps.requests, summary):
+                    return False
+        return True
 
     def _get_assignments(self, wl: Info, snapshot: Snapshot
                          ) -> tuple[Assignment, list[Target]]:

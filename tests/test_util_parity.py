@@ -1,0 +1,124 @@
+"""Small-library parity: LimitRange, expectations store, TAS profiles,
+LocalQueueUsage (reference pkg/util/limitrange, pkg/util/expectations,
+TAS profile gates, cache.go LocalQueueUsage)."""
+
+import pytest
+
+from kueue_tpu import features
+from kueue_tpu.api.types import (
+    ClusterQueue,
+    FlavorQuotas,
+    LocalQueue,
+    PodSet,
+    ResourceFlavor,
+    ResourceGroup,
+    ResourceQuota,
+    Workload,
+)
+from kueue_tpu.controller.driver import Driver
+from kueue_tpu.limitrange import (
+    LimitRange,
+    LimitRangeItem,
+    apply_defaults,
+    summarize,
+    validate,
+)
+from kueue_tpu.resources import FlavorResource
+from kueue_tpu.utils.expectations import Store
+
+
+def make_driver():
+    d = Driver(clock=lambda: 1000.0)
+    d.apply_resource_flavor(ResourceFlavor(name="default"))
+    d.apply_cluster_queue(ClusterQueue(
+        name="cq", resource_groups=[ResourceGroup(
+            covered_resources=["cpu"],
+            flavors=[FlavorQuotas(name="default", resources={
+                "cpu": ResourceQuota(nominal=10_000)})])]))
+    d.apply_local_queue(LocalQueue(name="lq", cluster_queue="cq"))
+    return d
+
+
+def test_limitrange_summarize_and_validate():
+    s = summarize([
+        LimitRange(name="a", items=[LimitRangeItem(
+            default={"cpu": 500}, min={"cpu": 100}, max={"cpu": 4000})]),
+        LimitRange(name="b", items=[LimitRangeItem(
+            min={"cpu": 200}, max={"cpu": 8000})]),
+    ])
+    assert s.default == {"cpu": 500}
+    assert s.min == {"cpu": 200}          # tightest min wins
+    assert s.max == {"cpu": 4000}         # tightest max wins
+    assert apply_defaults({}, s) == {"cpu": 500}
+    assert apply_defaults({"cpu": 300}, s) == {"cpu": 300}
+    assert validate({"cpu": 100}, s)      # below min
+    assert validate({"cpu": 5000}, s)     # above max
+    assert validate({"cpu": 1000}, s) == []
+
+
+def test_limitrange_blocks_oversized_workload():
+    d = make_driver()
+    d.apply_limit_range(LimitRange(name="lr", items=[
+        LimitRangeItem(max={"cpu": 2000}, default={"cpu": 1000})]))
+    d.create_workload(Workload(
+        name="too-big", queue_name="lq", creation_time=1.0,
+        pod_sets=[PodSet(name="main", count=1, requests={"cpu": 3000})]))
+    d.create_workload(Workload(
+        name="defaulted", queue_name="lq", creation_time=2.0,
+        pod_sets=[PodSet(name="main", count=1)]))
+    d.run_until_settled()
+    assert d.admitted_keys() == {"default/defaulted"}
+    # the defaulted workload got the LimitRange default request
+    fr = FlavorResource("default", "cpu")
+    assert d.cache.usage("cq").get(fr) == 1000
+
+
+def test_expectations_store():
+    s = Store("ungating")
+    assert s.satisfied("group-a")
+    s.expect_uids("group-a", ["p0", "p1"])
+    assert not s.satisfied("group-a")
+    s.observed_uid("group-a", "p0")
+    assert not s.satisfied("group-a")
+    s.observed_uid("group-a", "p1")
+    assert s.satisfied("group-a")
+    s.expect_uids("group-b", ["x"])
+    s.forget("group-b")
+    assert s.satisfied("group-b")
+
+
+def test_tas_most_free_profile():
+    from kueue_tpu.api.types import PodSetTopologyRequest
+    from kueue_tpu.cache.tas_cache import NodeInfo
+    from kueue_tpu.cache.tas_snapshot import TASFlavorSnapshot
+    nodes = [
+        NodeInfo(name="n1", labels={"rack": "tight"},
+                 capacity={"cpu": 4000}),
+        NodeInfo(name="n2", labels={"rack": "roomy"},
+                 capacity={"cpu": 16000}),
+    ]
+    snap = TASFlavorSnapshot.build("f", ["rack"], nodes, {})
+    req = PodSetTopologyRequest(required="rack")
+    asg, _ = snap.find_topology_assignment(2, {"cpu": 2000}, req)
+    assert asg.domains[0].values == ["tight"]      # BestFit default
+    with features.set_feature_gate_during_test(
+            "TASProfileMostFreeCapacity", True):
+        snap2 = TASFlavorSnapshot.build("f", ["rack"], nodes, {})
+        asg2, _ = snap2.find_topology_assignment(2, {"cpu": 2000}, req)
+    assert asg2.domains[0].values == ["roomy"]     # most free wins
+
+
+def test_local_queue_usage():
+    d = make_driver()
+    d.apply_local_queue(LocalQueue(name="lq2", cluster_queue="cq"))
+    d.create_workload(Workload(
+        name="w1", queue_name="lq", creation_time=1.0,
+        pod_sets=[PodSet(name="main", count=1, requests={"cpu": 2000})]))
+    d.create_workload(Workload(
+        name="w2", queue_name="lq2", creation_time=2.0,
+        pod_sets=[PodSet(name="main", count=1, requests={"cpu": 3000})]))
+    d.run_until_settled()
+    fr = FlavorResource("default", "cpu")
+    assert d.cache.local_queue_usage("default", "lq").get(fr) == 2000
+    assert d.cache.local_queue_usage("default", "lq2").get(fr) == 3000
+    assert d.cache.local_queue_usage("default", "nope") == {}
