@@ -236,13 +236,15 @@ class Cache:
         """Usage aggregated over a LocalQueue's admitted workloads
         (reference cache.go:786 LocalQueueUsage)."""
         out = FlavorResourceQuantities()
-        lq = self.local_queues.get(f"{namespace}/{lq_name}")
-        if lq is None:
-            return out
-        cq = self._mgr.cluster_queues.get(lq.cluster_queue)
-        if cq is None:
-            return out
-        for info in cq.workloads.values():
+        with self._lock:
+            lq = self.local_queues.get(f"{namespace}/{lq_name}")
+            if lq is None:
+                return out
+            cq = self._mgr.cluster_queues.get(lq.cluster_queue)
+            if cq is None:
+                return out
+            infos = list(cq.workloads.values())
+        for info in infos:
             wl = info.obj
             if wl.namespace == namespace and wl.queue_name == lq_name:
                 for fr, v in info.usage().items():

@@ -140,7 +140,9 @@ class TASFlavorSnapshot:
             total = sum(r.state for r in self.roots)
             if total < count:
                 return None, self._fit_message(count, total)
-            chosen = self._select_from(sorted(self.roots, key=self._domain_order), count)
+            chosen = self._select_from(
+                self._sorted_domains(self.roots, unconstrained=True),
+                count, unconstrained=True)
         else:
             if required_idx is not None:
                 fit_idx, domain = self._find_fit_at(required_idx, count)
@@ -159,7 +161,7 @@ class TASFlavorSnapshot:
                     if total < count:
                         return None, self._fit_message(count, total)
                     chosen = self._select_from(
-                        sorted(self.roots, key=self._domain_order), count)
+                        self._sorted_domains(self.roots), count)
                     return self._assignment_from(chosen), ""
             chosen = {domain: count}
         return self._assignment_from(chosen), ""
@@ -168,42 +170,72 @@ class TASFlavorSnapshot:
 
     @staticmethod
     def _domain_order(dom: Domain):
-        # BestFit: prefer tighter domains first to reduce fragmentation,
-        # largest-capacity ordering for splitting (fewest domains).
+        # default sortedDomains order: state descending, ties by id
+        # (reference tas_flavor_snapshot.go:631)
         return (-dom.state, dom.id)
+
+    @staticmethod
+    def _use_best_fit(unconstrained: bool = False) -> bool:
+        """reference tas_flavor_snapshot.go:551 useBestFitAlgorithm."""
+        from .. import features
+        if (features.enabled("TASProfileMostFreeCapacity")
+                or features.enabled("TASProfileLeastFreeCapacity")
+                or (unconstrained and features.enabled("TASProfileMixed"))):
+            return False
+        return True
+
+    @staticmethod
+    def _use_least_free(unconstrained: bool = False) -> bool:
+        """reference tas_flavor_snapshot.go:561."""
+        from .. import features
+        return (features.enabled("TASProfileLeastFreeCapacity")
+                or (unconstrained and features.enabled("TASProfileMixed")))
+
+    def _sorted_domains(self, domains: list[Domain],
+                        unconstrained: bool = False) -> list[Domain]:
+        """reference sortedDomains: state desc, ties by id; the
+        least-free profiles reverse the order."""
+        out = sorted(domains, key=self._domain_order)
+        if self._use_least_free(unconstrained):
+            out.reverse()
+        return out
 
     def _find_fit_at(self, level: int, count: int) -> tuple[int, Optional[Domain]]:
         """Best single domain at `level` that fits all pods.
 
-        Default BestFit: least spare capacity, ties by id; the
-        TASProfileMostFreeCapacity gate flips to most-free (reference
-        tas_flavor_snapshot.go:551-568 profile selection)."""
-        from .. import features
-        most_free = features.enabled("TASProfileMostFreeCapacity")
-        best = None
-        for dom in self.domains_per_level[level]:
-            if dom.state >= count:
-                if best is None:
-                    best = dom
-                elif most_free:
-                    if (-dom.state, dom.id) < (-best.state, best.id):
-                        best = dom
-                elif (dom.state, dom.id) < (best.state, best.id):
-                    best = dom
-        return level, best
+        Default BestFit picks the least spare capacity (reference
+        findBestFitDomainIdx); TASProfileMostFreeCapacity picks the most
+        free (the top of sortedDomains)."""
+        fitting = [d for d in self.domains_per_level[level]
+                   if d.state >= count]
+        if not fitting:
+            return level, None
+        if self._use_best_fit() or self._use_least_free():
+            return level, min(fitting, key=lambda d: (d.state, d.id))
+        return level, min(fitting, key=self._domain_order)
 
-    def _select_from(self, ordered: list[Domain], count: int) -> dict[Domain, int]:
-        """Greedy multi-domain split: take largest domains first (fewest
-        domains; reference updateCountsToMinimum)."""
+    def _select_from(self, ordered: list[Domain], count: int,
+                     unconstrained: bool = False) -> dict[Domain, int]:
+        """Multi-domain split over a sortedDomains list (reference
+        updateCountsToMinimum, tas_flavor_snapshot.go:571): walk the
+        order taking whole domains; under BestFit, once the remainder
+        fits a single domain, pick the tightest such domain for it."""
         chosen: dict[Domain, int] = {}
         remaining = count
-        for dom in ordered:
+        best_fit = self._use_best_fit(unconstrained)
+        for i, dom in enumerate(ordered):
             if remaining <= 0:
                 break
-            take = min(dom.state, remaining)
-            if take > 0:
-                chosen[dom] = take
-                remaining -= take
+            if best_fit and dom.state >= remaining:
+                # optimize the last domain (findBestFitDomainIdx)
+                dom = min((d for d in ordered[i:] if d.state >= remaining),
+                          key=lambda d: (d.state, d.id))
+            if dom.state >= remaining:
+                chosen[dom] = chosen.get(dom, 0) + remaining
+                return chosen
+            if dom.state > 0:
+                chosen[dom] = chosen.get(dom, 0) + dom.state
+                remaining -= dom.state
         return chosen
 
     def _assignment_from(self, chosen: dict[Domain, int]) -> TopologyAssignment:
@@ -219,15 +251,10 @@ class TASFlavorSnapshot:
         if not dom.children:  # leaf
             out[dom.id] = out.get(dom.id, 0) + cnt
             return
-        remaining = cnt
-        # BestFit at each level: pick the fullest-fitting children first
-        for child in sorted(dom.children, key=self._domain_order):
-            if remaining <= 0:
-                break
-            take = min(child.state, remaining)
-            if take > 0:
-                self._descend(child, take, out)
-                remaining -= take
+        # updateCountsToMinimum over the children at each level
+        for child, take in self._select_from(
+                self._sorted_domains(dom.children), cnt).items():
+            self._descend(child, take, out)
 
     def _fit_message(self, count: int, total: int) -> str:
         return (f"topology {self.flavor!r} allows to fit only {total} "

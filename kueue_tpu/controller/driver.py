@@ -158,6 +158,8 @@ class Driver:
         self.limit_ranges.setdefault(lr.namespace, {})[lr.name] = lr
         self.scheduler.limit_range_summaries[lr.namespace] = summarize(
             list(self.limit_ranges[lr.namespace].values()))
+        # a relaxed range can unblock parked workloads
+        self._wake_all()
 
     def apply_workload_priority_class(self, pc) -> None:
         """reference WorkloadPriorityClass (pkg/util/priority)."""

@@ -28,7 +28,10 @@ class LimitRange:
 
 @dataclass
 class Summary:
-    """reference limitrange.Summarize: per-type combined bounds."""
+    """Combined per-pod bounds.  Pod sets here are single-container
+    (requests are per pod), so Container- and Pod-type items both bound
+    the same per-pod requests; defaults are honored only from
+    Container-type items (the reference forbids Pod-type defaults)."""
     default: dict[str, int] = field(default_factory=dict)
     min: dict[str, int] = field(default_factory=dict)    # per pod
     max: dict[str, int] = field(default_factory=dict)
@@ -40,8 +43,9 @@ def summarize(ranges: list[LimitRange]) -> Summary:
         for item in lr.items:
             if item.type not in ("Container", "Pod"):
                 continue
-            for r, v in item.default.items():
-                s.default.setdefault(r, v)
+            if item.type == "Container":
+                for r, v in item.default.items():
+                    s.default.setdefault(r, v)
             for r, v in item.min.items():
                 # the tightest (largest) min wins
                 s.min[r] = max(s.min.get(r, v), v)

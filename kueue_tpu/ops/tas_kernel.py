@@ -110,8 +110,33 @@ def _best_at_level(state, count):
     return jnp.where(jnp.any(fits), best, -1)
 
 
+def _seg_scan_sum(values, first_of_seg):
+    """Inclusive in-segment prefix sum (segments = runs where
+    first_of_seg marks the start)."""
+    def combine(a, b):
+        av, af = a
+        bv, bf = b
+        return jnp.where(bf, bv, av + bv), af | bf
+    out, _ = jax.lax.associative_scan(combine, (values, first_of_seg))
+    return out
+
+
+def _seg_broadcast_max(values, first_of_seg):
+    """Inclusive in-segment running max."""
+    def combine(a, b):
+        av, af = a
+        bv, bf = b
+        return jnp.where(bf, bv, jnp.maximum(av, bv)), af | bf
+    out, _ = jax.lax.associative_scan(combine, (values, first_of_seg))
+    return out
+
+
 def _allocate_level(parent_counts, par, state):
-    """Distribute parent counts over children in (-state, idx) order.
+    """Distribute parent counts over children in (-state, idx) order with
+    the reference's best-fit last-domain optimization
+    (updateCountsToMinimum, tas_flavor_snapshot.go:571): take whole
+    domains largest-first; once the remainder fits a single domain, give
+    it to the tightest domain that still fits it.
 
     parent_counts: [N_l]; par: [N_{l+1}] parent idx; state: [N_{l+1}].
     Returns child_counts [N_{l+1}].
@@ -120,18 +145,37 @@ def _allocate_level(parent_counts, par, state):
     order = jnp.lexsort((jnp.arange(n), -state, par))   # group, then -state
     par_o = par[order]
     state_o = state[order]
-    # in-segment exclusive prefix sum of state (segments = equal par_o runs)
-    csum = jnp.cumsum(state_o)
-    first_of_seg = jnp.concatenate(
-        [jnp.array([True]), par_o[1:] != par_o[:-1]])
-    # running max of the segment-start cumsum works because csum is
-    # nondecreasing, so each segment's base dominates all earlier ones
-    seg_base = jax.lax.associative_scan(jnp.maximum,
-                                        jnp.where(first_of_seg,
-                                                  csum - state_o, 0))
-    prev = (csum - state_o) - seg_base
+    first = jnp.concatenate([jnp.array([True]), par_o[1:] != par_o[:-1]])
+    # in-segment exclusive prefix sum of state
+    exc = _seg_scan_sum(state_o, first) - state_o
     cnt_o = parent_counts[par_o]
-    take_o = jnp.clip(cnt_o - prev, 0, state_o)
+    remaining = cnt_o - exc                              # before child k
+    absorb = (state_o >= remaining) & (remaining > 0)
+    # j = first absorbing child per segment; positions k < j have
+    # state < remaining (so greedy = full state); positions k >= j give
+    # the remainder to the tightest fitting child
+    ab_count = _seg_scan_sum(absorb.astype(jnp.int32), first)
+    is_j = absorb & (ab_count == 1)
+    has_j = ab_count >= 1                                # running: k >= j
+    rem_j = _seg_broadcast_max(jnp.where(is_j, remaining, 0), first)
+    # best-fit last domain: the tightest child (min state, ties by id =
+    # position order) with state >= rem_j — always at index >= j
+    cand = (has_j & (rem_j > 0) & (state_o >= rem_j)) | is_j
+    first_rev = jnp.concatenate(
+        [jnp.array([True]), par_o[::-1][1:] != par_o[::-1][:-1]])
+    # min candidate state per segment = state at the last candidate
+    # (desc order); broadcast it backward over the segment
+    cand_rev_count = _seg_scan_sum(cand[::-1].astype(jnp.int32), first_rev)
+    is_last_cand = (cand[::-1] & (cand_rev_count == 1))[::-1]
+    min_state = _seg_broadcast_max(
+        jnp.where(is_last_cand[::-1], state_o[::-1], 0), first_rev)[::-1]
+    # the pick: FIRST candidate holding the minimal state (id tie-break)
+    tight = cand & (state_o == min_state)
+    tight_count = _seg_scan_sum(tight.astype(jnp.int32), first)
+    is_pick = tight & (tight_count == 1)
+
+    greedy = jnp.clip(remaining, 0, state_o)             # also covers k < j
+    take_o = jnp.where(has_j, jnp.where(is_pick, rem_j, 0), greedy)
     out = jnp.zeros(n, dtype=parent_counts.dtype).at[order].set(take_o)
     return out
 
@@ -167,14 +211,10 @@ def split_across_roots(leaf_free, per_pod, parents, count,
     root_state = states[0]
     total = jnp.sum(root_state)
     ok = total >= count
-    # take from largest roots first (fewest domains)
+    # roots form one segment: largest-first with best-fit last domain
     n = root_state.shape[0]
-    order = jnp.lexsort((jnp.arange(n), -root_state))
-    state_o = root_state[order]
-    prev = jnp.cumsum(state_o) - state_o
-    take_o = jnp.clip(count - prev, 0, state_o)
-    counts = jnp.zeros(n, dtype=jnp.int32).at[order].set(
-        take_o.astype(jnp.int32))
+    counts = _allocate_level(jnp.array([count], dtype=jnp.int32),
+                             jnp.zeros(n, dtype=jnp.int32), root_state)
     counts = jnp.where(ok, counts, 0)
     for lvl in range(0, len(level_sizes) - 1):
         counts = _allocate_level(counts, parents[lvl], states[lvl + 1])
