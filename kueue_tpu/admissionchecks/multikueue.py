@@ -64,7 +64,9 @@ class MultiKueueController:
                  config: MultiKueueConfig,
                  clusters: dict[str, WorkerCluster],
                  origin: str = "multikueue",
-                 worker_lost_timeout: float = 300.0):
+                 worker_lost_timeout: float = 300.0,
+                 manager_jobs=None,
+                 worker_jobs: dict[str, object] | None = None):
         self.manager = manager_driver
         self.check_name = check_name
         self.config = config
@@ -72,6 +74,11 @@ class MultiKueueController:
         self.origin = origin
         self.worker_lost_timeout = worker_lost_timeout
         self.assignments: dict[str, _Assignment] = {}
+        # optional job-level dispatch (reference MultiKueueAdapter.SyncJob,
+        # jobframework/interface.go:227): the manager's JobManager plus one
+        # per worker cluster; jobs are mirrored instead of bare workloads
+        self.manager_jobs = manager_jobs
+        self.worker_jobs = worker_jobs or {}
 
     # ------------------------------------------------------------------
 
@@ -108,15 +115,43 @@ class MultiKueueController:
 
     # ------------------------------------------------------------------
 
+    def _owner_job(self, wl: Workload):
+        """The manager-side job owning this workload, if job-level
+        dispatch is attached."""
+        if self.manager_jobs is None:
+            return None
+        for job in self.manager_jobs.jobs.values():
+            wl_key = self.manager_jobs.reconciler.workload_key_for(job)
+            if wl_key == wl.key:
+                return job
+        return None
+
+    def _sync_job(self, cname: str, job) -> None:
+        """Mirror the job object to a worker cluster (adapter SyncJob):
+        the worker's own jobframework creates and manages the workload."""
+        import copy
+        worker_jm = self.worker_jobs.get(cname)
+        if worker_jm is None:
+            return
+        if job.key in worker_jm.jobs:
+            return
+        clone = copy.deepcopy(job)
+        if hasattr(clone, "set_managed_by"):
+            clone.set_managed_by(None)   # the worker runs it for real
+        worker_jm.upsert(clone)
+
     def _nominate(self, key: str, wl: Workload) -> None:
         """Create mirrors on every configured active cluster
         (workload.go nominateAndSynchronizeWorkers)."""
+        job = self._owner_job(wl)
         nominated = []
         for cname in self.config.clusters:
             cluster = self.clusters.get(cname)
             if cluster is None or not cluster.active:
                 continue
-            if wl.key not in cluster.driver.workloads:
+            if job is not None and cname in self.worker_jobs:
+                self._sync_job(cname, job)
+            elif wl.key not in cluster.driver.workloads:
                 cluster.driver.create_workload(self._mirror(wl))
             nominated.append(cname)
         if not nominated:
@@ -155,6 +190,15 @@ class MultiKueueController:
             # remote deleted under us → re-dispatch
             self._reset(key)
             return
+        # job-level dispatch: copy the remote job's execution status back
+        # to the (suspended) manager job (reference workload.go copy-back)
+        job = self._owner_job(wl)
+        if job is not None:
+            worker_jm = self.worker_jobs.get(asg.cluster)
+            if worker_jm is not None:
+                worker_job = worker_jm.jobs.get(job.key)
+                if worker_job is not None:
+                    job.sync_status_from(worker_job)
         if remote.is_finished:
             msg = remote.conditions.get("Finished")
             self.manager.finish_workload(
@@ -165,8 +209,16 @@ class MultiKueueController:
 
     def _delete_remote(self, cname: str, key: str) -> None:
         cluster = self.clusters.get(cname)
-        if cluster is not None and cluster.active:
-            cluster.driver.delete_workload(key)
+        if cluster is None or not cluster.active:
+            return
+        worker_jm = self.worker_jobs.get(cname)
+        if worker_jm is not None:
+            # job-level mirrors: delete the worker job (cascades to its
+            # workload via the worker JobManager)
+            for jkey, job in list(worker_jm.jobs.items()):
+                if worker_jm.reconciler.workload_key_for(job) == key:
+                    worker_jm.delete(jkey)
+        cluster.driver.delete_workload(key)
 
     def _cleanup(self, key: str) -> None:
         asg = self.assignments.pop(key, None)

@@ -96,6 +96,10 @@ class GenericJob(abc.ABC):
         """All pods running+ready (reference interface.go:61)."""
         return self.is_active()
 
+    def sync_status_from(self, other: "GenericJob") -> None:
+        """Copy execution status from a remote copy of this job
+        (MultiKueue adapter copy-back, reference workload.go)."""
+
 
 class JobWithReclaimablePods(abc.ABC):
     """reference interface.go:75."""

@@ -19,6 +19,7 @@ class Component:
 
 class AppWrapper(TemplateJob):
     kind = "AppWrapper"
+    STATUS_FIELDS = ("phase",)
 
     def __init__(self, name: str, components: list[Component], **kw):
         templates = [PodTemplate(name=c.name, count=c.count,

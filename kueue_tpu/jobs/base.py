@@ -42,6 +42,9 @@ class TemplateJob(GenericJob, JobWithManagedBy):
     """Base for template-driven integrations: suspend flag + overlay."""
 
     kind = "TemplateJob"
+    # execution-status fields mirrored back from a remote copy
+    # (MultiKueue adapter copy-back)
+    STATUS_FIELDS: tuple[str, ...] = ()
 
     def __init__(self, name: str, namespace: str = "default",
                  queue: str = "", templates: Sequence[PodTemplate] = (),
@@ -137,3 +140,7 @@ class TemplateJob(GenericJob, JobWithManagedBy):
 
     def finished(self) -> tuple[str, bool, bool]:
         return "", False, False
+
+    def sync_status_from(self, other: "TemplateJob") -> None:
+        for field_name in self.STATUS_FIELDS:
+            setattr(self, field_name, getattr(other, field_name))

@@ -22,6 +22,7 @@ from .base import PodTemplate, TemplateJob
 
 class BatchJob(TemplateJob, JobWithReclaimablePods):
     kind = "BatchJob"
+    STATUS_FIELDS = ("succeeded", "failed_message", "parallelism")
 
     def __init__(self, name: str, parallelism: int = 1,
                  completions: Optional[int] = None,

@@ -29,6 +29,7 @@ class ReplicatedJobSpec:
 
 class JobSet(TemplateJob, JobWithReclaimablePods):
     kind = "JobSet"
+    STATUS_FIELDS = ("succeeded", "failed_message")
 
     def __init__(self, name: str, replicated_jobs: list[ReplicatedJobSpec],
                  **kw):

@@ -29,6 +29,7 @@ class KubeflowJob(TemplateJob):
     """Common adapter (reference kubeflowjob.KubeflowJob)."""
 
     kind = "KubeflowJob"
+    STATUS_FIELDS = ("condition",)
     # roles ordered first in the workload's pod sets (reference orders
     # Master before Worker for stable PodSet naming)
     role_order: tuple[str, ...] = ()

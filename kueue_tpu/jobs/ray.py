@@ -35,6 +35,7 @@ def _cluster_templates(head_requests: dict[str, int],
 
 class RayJob(TemplateJob):
     kind = "RayJob"
+    STATUS_FIELDS = ("job_status",)
 
     def __init__(self, name: str, head_requests: dict[str, int],
                  worker_groups: list[WorkerGroupSpec], **kw):
@@ -57,6 +58,7 @@ class RayCluster(TemplateJob):
     """A serving-style cluster: admitted while it exists."""
 
     kind = "RayCluster"
+    STATUS_FIELDS = ("deleted",)
 
     def __init__(self, name: str, head_requests: dict[str, int],
                  worker_groups: list[WorkerGroupSpec], **kw):
