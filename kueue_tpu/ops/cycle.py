@@ -137,3 +137,135 @@ def solve_cycle(usage0, subtree, guaranteed, borrow_cap, has_blim, parent,
     borrows = jnp.zeros(W, dtype=bool).at[order_out].set(borrows_o)
 
     return admitted, slots, borrows, preempt0, fit_slot0, borrows0
+
+
+def add_usage_chain_batched(usage, nodes, deltas, guaranteed, parent,
+                            depth: int):
+    """add_usage_chain for G disjoint ancestor chains at once.
+
+    nodes: [G] int32 (-1 = no-op); deltas: [G, F] int32.  Chains in
+    different cohort forests never share nodes, so the per-level
+    scatter-adds commute."""
+    def body(i, state):
+        usage, cur, carry = state                     # [G], [G, F]
+        valid = cur >= 0
+        cur_safe = jnp.maximum(cur, 0)
+        local_avail = jnp.maximum(0, guaranteed[cur_safe] - usage[cur_safe])
+        add = jnp.where(valid[:, None], carry, 0)
+        usage = usage.at[cur_safe].add(add)
+        next_carry = jnp.maximum(0, carry - local_avail)
+        next_cur = jnp.where(valid, parent[cur_safe], -1)
+        return usage, next_cur, jnp.where(valid[:, None], next_carry, carry)
+
+    usage, _, _ = jax.lax.fori_loop(
+        0, depth, body, (usage, nodes.astype(jnp.int32), deltas))
+    return usage
+
+
+@partial(jax.jit, static_argnames=("depth", "n_forests", "max_forest_wl"))
+def solve_cycle_forests(usage0, subtree, guaranteed, borrow_cap, has_blim,
+                        parent, nominal_cq, slot_fr, slot_valid,
+                        cq_can_preempt_borrow, wl_cq, wl_requests,
+                        wl_priority, wl_timestamp, forest_of_node,
+                        *, depth: int, n_forests: int, max_forest_wl: int):
+    """The admit scan parallelized over independent cohort forests.
+
+    Quota never flows between forests, so the sequential within-cycle
+    semantics only constrain workloads of the SAME forest; each scan step
+    admits one workload per forest simultaneously (scatter-adds on
+    disjoint chains).  Scan length drops from W to max_forest_wl — the
+    lever that takes the north-star 1k-head cycle from O(heads) to
+    O(heads / forests) (SURVEY §7 hard part (a), exploited structurally).
+
+    Decision-identical to solve_cycle(run_scan=True); enforced by
+    tests/test_forest_scan.py."""
+    W = wl_cq.shape[0]
+    G = n_forests + 1                       # + padding bucket
+
+    # phase 1 + global ordering (identical to solve_cycle)
+    _, _, _, preempt0, fit_slot0, borrows0 = solve_cycle(
+        usage0, subtree, guaranteed, borrow_cap, has_blim, parent,
+        nominal_cq, slot_fr, slot_valid, cq_can_preempt_borrow,
+        wl_cq, wl_requests, wl_priority, wl_timestamp,
+        depth=depth, run_scan=False)
+    order = jnp.lexsort((jnp.arange(W), wl_timestamp, -wl_priority,
+                         borrows0.astype(jnp.int32)))
+    inv_order = jnp.zeros(W, dtype=jnp.int32).at[order].set(
+        jnp.arange(W, dtype=jnp.int32))
+
+    f_w = jnp.where(wl_cq >= 0,
+                    forest_of_node[jnp.maximum(wl_cq, 0)], n_forests)
+    # group by forest, cycle order within each group
+    p = jnp.lexsort((inv_order, f_w))                    # [W]
+    f_sorted = f_w[p]
+    first = jnp.concatenate([jnp.array([True]),
+                             f_sorted[1:] != f_sorted[:-1]])
+    pos = jnp.arange(W)
+    seg_start = jax.lax.associative_scan(
+        jnp.maximum, jnp.where(first, pos, 0))
+    rank = (pos - seg_start).astype(jnp.int32)           # in-forest rank
+    mat = jnp.full((G, max_forest_wl), -1, dtype=jnp.int32)
+    # ranks beyond max_forest_wl are dropped (host sizes the bucket)
+    mat = mat.at[f_sorted, rank].set(p.astype(jnp.int32), mode="drop")
+
+    def classify_g(avail, usage, wi):
+        """Per-forest step: classify workload wi (or -1)."""
+        wl_cq_i = jnp.where(wi >= 0, wl_cq[jnp.maximum(wi, 0)], -1)
+        valid = wl_cq_i >= 0
+        req = wl_requests[jnp.maximum(wi, 0)]
+        # reuse the classification from solve_cycle via a fresh pass
+        cq = jnp.maximum(wl_cq_i, 0)
+        frs = slot_fr[cq]
+        frs_safe = jnp.maximum(frs, 0)
+        covered = frs >= 0
+        needed = req[None, :] > 0
+        missing = jnp.any(needed & ~covered, axis=1)
+        av = avail[cq][frs_safe]
+        nom = nominal_cq[cq][frs_safe]
+        use = usage[cq][frs_safe]
+        sq = subtree[cq][frs_safe]
+        relevant = covered & needed
+        fit_r = req[None, :] <= av
+        fit = (jnp.all(jnp.where(relevant, fit_r, True), axis=1)
+               & ~missing & slot_valid[cq])
+        has_parent = parent[cq] >= 0
+        borrow_r = jnp.where(relevant, use + req[None, :] > sq, False)
+        borrows_s = jnp.any(borrow_r, axis=1) & has_parent
+        fit_idx = jnp.argmax(fit)
+        has_fit = jnp.any(fit) & valid
+        fit_slot = jnp.where(has_fit, fit_idx, -1)
+        borrows = jnp.where(has_fit, borrows_s[fit_idx], False)
+        return fit_slot, borrows
+
+    def step(usage, col):
+        wis = mat[:, col]                                # [G]
+        avail = available_all(usage, subtree, guaranteed, borrow_cap,
+                              has_blim, parent, depth)
+        fit_slot, borrows = jax.vmap(
+            lambda wi: classify_g(avail, usage, wi))(wis)
+        admit = fit_slot >= 0
+        cqs = jnp.where(admit, wl_cq[jnp.maximum(wis, 0)], -1)
+        frs = slot_fr[jnp.maximum(cqs, 0),
+                      jnp.maximum(fit_slot, 0)]          # [G, R]
+        reqs = wl_requests[jnp.maximum(wis, 0)]          # [G, R]
+        deltas = jnp.zeros((G, usage.shape[1]), dtype=usage.dtype)
+        deltas = deltas.at[jnp.arange(G)[:, None],
+                           jnp.maximum(frs, 0)].add(
+            jnp.where((frs >= 0) & admit[:, None], reqs, 0))
+        usage = add_usage_chain_batched(usage, cqs, deltas, guaranteed,
+                                        parent, depth)
+        return usage, (wis, admit, fit_slot, borrows)
+
+    _, (wis_o, admit_o, slot_o, borrows_o) = jax.lax.scan(
+        step, usage0, jnp.arange(max_forest_wl))
+
+    wis_flat = wis_o.reshape(-1)
+    safe = jnp.maximum(wis_flat, 0)
+    mask = wis_flat >= 0
+    admitted = jnp.zeros(W, dtype=bool).at[safe].max(
+        admit_o.reshape(-1) & mask)
+    slots = jnp.full(W, -1, dtype=jnp.int32).at[safe].max(
+        jnp.where(mask, slot_o.reshape(-1), -1))
+    borrows = jnp.zeros(W, dtype=bool).at[safe].max(
+        borrows_o.reshape(-1) & mask)
+    return admitted, slots, borrows, preempt0, fit_slot0, borrows0

@@ -65,6 +65,8 @@ class PackedCycle:
     wl_keys: list[str] = field(default_factory=list)
     exact: bool = True                   # scaled comparisons are lossless
     fair_weight_milli: np.ndarray = None  # [N] int32 (fair sharing)
+    forest_of_node: np.ndarray = None    # [N] int32 root-forest id
+    n_forests: int = 0
 
 
 def _bucket(n: int, minimum: int = 8) -> int:
@@ -218,14 +220,19 @@ def pack_cycle(snapshot: Snapshot, heads: list[Info],
                 borrow_cap[ni, fi] = scaled(fr.resource,
                                             stored + q.borrowing_limit)
 
-    # depth
+    # depth + forest partition (each parent-pointer root is independent)
     depth = 1
+    forest_of_node = np.zeros(N, dtype=np.int32)
+    root_forest: dict[int, int] = {}
     for ni in range(N):
-        d, p = 1, parent[ni]
+        d, p, cur = 1, parent[ni], ni
         while p >= 0:
             d += 1
+            cur = p
             p = parent[p]
         depth = max(depth, d)
+        forest_of_node[ni] = root_forest.setdefault(cur, len(root_forest))
+    n_forests = max(1, len(root_forest))
 
     # flavor slots per CQ
     S = 1
@@ -286,4 +293,5 @@ def pack_cycle(snapshot: Snapshot, heads: list[Info],
         wl_count=len(heads), wl_cq=wl_cq, wl_requests=wl_requests,
         wl_priority=wl_priority, wl_timestamp=wl_timestamp, wl_keys=wl_keys,
         exact=exact, fair_weight_milli=fair_weight,
+        forest_of_node=forest_of_node, n_forests=n_forests,
     )

@@ -1,0 +1,102 @@
+"""Forest-parallel admit scan parity: solve_cycle_forests must produce
+bit-identical decisions to the flat sequential scan (quota never crosses
+cohort forests, so per-forest lockstep admission is legal)."""
+
+import random
+
+import numpy as np
+import pytest
+
+from kueue_tpu.api.types import (
+    ClusterQueue,
+    Cohort,
+    FlavorQuotas,
+    LocalQueue,
+    PodSet,
+    ResourceFlavor,
+    ResourceGroup,
+    ResourceQuota,
+    Workload,
+)
+from kueue_tpu.controller.driver import Driver
+from kueue_tpu.ops.cycle import solve_cycle, solve_cycle_forests
+from kueue_tpu.ops.packing import pack_cycle
+from kueue_tpu.parallel import cycle_args
+
+
+def build_packed(seed, n_forests=4, cqs_per_forest=3, n_wl=24):
+    rng = random.Random(seed)
+    d = Driver(clock=lambda: 1000.0)
+    d.apply_resource_flavor(ResourceFlavor(name="default"))
+    for f in range(n_forests):
+        d.apply_cohort(Cohort(name=f"forest-{f}"))
+        for q in range(cqs_per_forest):
+            name = f"cq-{f}-{q}"
+            d.apply_cluster_queue(ClusterQueue(
+                name=name, cohort=f"forest-{f}",
+                resource_groups=[ResourceGroup(
+                    covered_resources=["cpu"],
+                    flavors=[FlavorQuotas(name="default", resources={
+                        "cpu": ResourceQuota(
+                            nominal=rng.choice([2000, 4000]),
+                            borrowing_limit=2000)})])]))
+            d.apply_local_queue(LocalQueue(name=f"lq-{f}-{q}",
+                                           cluster_queue=name))
+    workloads = []
+    for i in range(n_wl):
+        f = rng.randrange(n_forests)
+        q = rng.randrange(cqs_per_forest)
+        workloads.append(Workload(
+            name=f"wl-{i}", queue_name=f"lq-{f}-{q}",
+            priority=rng.choice([0, 50, 100]),
+            creation_time=float(i + 1),
+            pod_sets=[PodSet(name="main", count=1,
+                             requests={"cpu": rng.choice(
+                                 [500, 1000, 2000, 3000])})]))
+    for wl in workloads:
+        d.create_workload(wl)
+    heads = d.queues.heads_nonblocking()
+    # give every pending workload a cycle seat to stress the scan
+    extra = []
+    seen = {h.key for h in heads}
+    for name in d.queues.cluster_queue_names():
+        for info in d.queues.pending_workloads_info(name):
+            if info.key not in seen:
+                seen.add(info.key)
+                extra.append(info)
+    snapshot = d.cache.snapshot()
+    d.scheduler.nominate(heads + extra, snapshot)
+    return pack_cycle(snapshot, heads + extra, d.scheduler.ordering)
+
+
+@pytest.mark.parametrize("seed", [1, 2, 3, 4])
+def test_forest_scan_matches_flat_scan(seed):
+    packed = build_packed(seed)
+    args = cycle_args(packed)
+    flat = solve_cycle(*args, depth=packed.depth, run_scan=True)
+    forest = solve_cycle_forests(
+        *args, packed.forest_of_node, depth=packed.depth,
+        n_forests=packed.n_forests,
+        max_forest_wl=packed.wl_cq.shape[0])
+    for i, name in enumerate(("admitted", "slots", "borrows")):
+        np.testing.assert_array_equal(
+            np.asarray(flat[i]), np.asarray(forest[i]),
+            err_msg=f"{name} diverged (seed {seed})")
+    assert np.asarray(flat[0]).any(), "degenerate: nothing admitted"
+
+
+def test_forest_scan_tight_bucket():
+    """max_forest_wl sized to the真 max group still matches."""
+    packed = build_packed(7)
+    wl_cq = packed.wl_cq
+    f_w = [packed.forest_of_node[c] if c >= 0 else packed.n_forests
+           for c in wl_cq]
+    from collections import Counter
+    max_group = max(Counter(f_w).values())
+    args = cycle_args(packed)
+    flat = solve_cycle(*args, depth=packed.depth, run_scan=True)
+    forest = solve_cycle_forests(
+        *args, packed.forest_of_node, depth=packed.depth,
+        n_forests=packed.n_forests, max_forest_wl=max_group)
+    np.testing.assert_array_equal(np.asarray(flat[0]),
+                                  np.asarray(forest[0]))
