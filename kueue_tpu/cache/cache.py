@@ -48,6 +48,9 @@ class Cache:
         self.info_options = info_options or InfoOptions()
         self.fair_sharing_enabled = fair_sharing_enabled
         self.tas = TASCache()
+        # Bumped on any spec-level change (CQ/cohort/flavor/check); the
+        # solver caches its packed structure tensors against this.
+        self.structure_generation = 0
 
     # ------------------------------------------------------------------
     # ClusterQueues / Cohorts
@@ -218,6 +221,7 @@ class Cache:
                 resource_flavors=dict(self.resource_flavors),
                 tas_flavors=self.tas.snapshot(),
                 fair_sharing_enabled=self.fair_sharing_enabled,
+                structure_generation=self.structure_generation,
             )
 
     # ------------------------------------------------------------------
@@ -287,6 +291,7 @@ class Cache:
         self._update_all_statuses()
 
     def _update_all_statuses(self) -> None:
+        self.structure_generation += 1
         for name, cq in self._mgr.cluster_queues.items():
             reasons = []
             for rg in cq.spec.resource_groups:

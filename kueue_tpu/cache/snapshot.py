@@ -24,13 +24,15 @@ class Snapshot:
                  inactive_cluster_queues: set[str],
                  resource_flavors: dict,
                  tas_flavors: dict | None = None,
-                 fair_sharing_enabled: bool = False):
+                 fair_sharing_enabled: bool = False,
+                 structure_generation: int = -1):
         self.cluster_queues = cluster_queues
         self.roots = roots
         self.inactive_cluster_queues = inactive_cluster_queues
         self.resource_flavors = resource_flavors
         self.tas_flavors = tas_flavors or {}
         self.fair_sharing_enabled = fair_sharing_enabled
+        self.structure_generation = structure_generation
 
     def cq(self, name: str) -> Optional[CQState]:
         return self.cluster_queues.get(name)

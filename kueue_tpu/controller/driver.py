@@ -74,7 +74,7 @@ class Driver:
                  wait_for_pods_ready: WaitForPodsReadyConfig | None = None,
                  namespaces: Optional[dict[str, dict[str, str]]] = None,
                  use_device_solver: bool = False,
-                 solver_backend: str = "device",
+                 solver_backend: str = "auto",
                  validate: bool = True):
         self.clock = clock
         self.wait_for_pods_ready = wait_for_pods_ready or WaitForPodsReadyConfig()

@@ -1,18 +1,23 @@
-"""The batched admission cycle as one jitted program.
+"""The batched admission cycle: vectorized nominate + sequential admit scan.
 
 Phase 1 (vectorized nominate): classify every head against every flavor
 slot at once — Fit / Preempt-capable / NoFit — mirroring
 findFlavorForPodSetResource (flavorassigner.go:499) under the default
-FlavorFungibility policy.
+FlavorFungibility policy.  Production runs this phase in numpy on the host
+(``classify_np``): it is O(W·S·R) array math, and keeping it host-side
+avoids a device round-trip before the admit scan is dispatched.
 
-Phase 2 (lax.scan admit loop): entries ordered by (borrows, priority desc,
-timestamp) as in entryOrdering.Less (scheduler.go:567); the usage tensor
-[N, F] is the scan carry so later entries see earlier admissions — the
-within-cycle sequential semantics of the reference admit loop.
+Phase 2 (``admit_scan``): the sequential admit loop as one jitted
+``lax.scan`` over the cycle order.  Assignments are FIXED at nominate time
+(phase 1) — each step only re-checks that the chosen slot still fits under
+the usage mutated by earlier steps, exactly like the reference admit loop
+(scheduler.go:245 fits re-check; it never re-runs flavor assignment).
+Preempt-classified entries with no preemption candidates reserve capacity
+(resourcesToReserve, scheduler.go:383-408) so later entries can't jump
+ahead.
 
-Preemption-capable entries are flagged; when any exist the host falls back
-to the scalar path for the whole cycle (bit-matching; device-side
-preemption search lands in a later round).
+``solve_cycle`` / ``solve_cycle_forests`` keep the one-call probe/test
+surface (phase 1 + scan in a single jitted program).
 """
 
 from __future__ import annotations
@@ -21,9 +26,204 @@ from functools import partial
 
 import jax
 import jax.numpy as jnp
+import numpy as np
 
 from .quota_kernel import available_all, add_usage_chain
 
+
+# ----------------------------------------------------------------------
+# Host-side (numpy) phase 1
+# ----------------------------------------------------------------------
+
+def available_all_np(usage, subtree, guaranteed, borrow_cap, has_blim,
+                     parent, depth: int) -> np.ndarray:
+    """numpy twin of quota_kernel.available_all (resource_node.go:89)."""
+    is_root = (parent < 0)[:, None]
+    parent_safe = np.maximum(parent, 0)
+    root_avail = subtree.astype(np.int64) - usage
+    local = np.maximum(0, guaranteed.astype(np.int64) - usage)
+    used_in_parent = np.maximum(0, usage.astype(np.int64) - guaranteed)
+    blim_cap = borrow_cap.astype(np.int64) - used_in_parent
+    avail = root_avail.copy()
+    for _ in range(depth):
+        parent_avail = avail[parent_safe]
+        parent_avail = np.where(has_blim, np.minimum(blim_cap, parent_avail),
+                                parent_avail)
+        avail = np.where(is_root, root_avail, local + parent_avail)
+    return avail
+
+
+def classify_np(packed, avail0=None, potential0=None):
+    """Vectorized nominate on the host: per-head slot classification.
+
+    Returns a dict of [W]-shaped arrays:
+      fit_slot0     first Fit slot or -1 (classify(avail0), first-fit under
+                    default fungibility — flavorassigner.go:499)
+      borrows0      the fit assignment borrows
+      preempt0      no fit, but some slot is preempt-capable
+      preempt_slot0 first preempt-capable slot (best under default policy)
+      preempt_borrows0  that preempt assignment borrows
+      preempt_res_fit   [W, R] per-resource Fit flag on the preempt slot
+                    (False ⇒ the resource is the one needing preemption)
+    """
+    st = packed.structure
+    usage0 = packed.usage0
+    if avail0 is None:
+        avail0 = available_all_np(
+            usage0, st.subtree_quota, st.guaranteed, st.borrow_cap,
+            st.has_borrow_limit, st.parent, st.depth)
+    if potential0 is None:
+        potential0 = available_all_np(
+            np.zeros_like(usage0), st.subtree_quota, st.guaranteed,
+            st.borrow_cap, st.has_borrow_limit, st.parent, st.depth)
+
+    wl_cq = packed.wl_cq
+    req = packed.wl_requests.astype(np.int64)[:, None, :]   # [W,1,R]
+    cqs = np.maximum(wl_cq, 0)
+    frs = st.slot_fr[cqs]                                   # [W,S,R]
+    frs_safe = np.maximum(frs, 0)
+    covered = frs >= 0
+    needed = req > 0
+    missing = np.any(needed & ~covered, axis=2)             # [W,S]
+    av = avail0[cqs[:, None, None], frs_safe]               # [W,S,R]
+    pot = potential0[cqs[:, None, None], frs_safe]
+    nom = st.nominal_cq[cqs[:, None, None], frs_safe]
+    use = usage0[cqs[:, None, None], frs_safe]
+    sq = st.subtree_quota[cqs[:, None, None], frs_safe]
+
+    relevant = covered & needed
+    fit_r = req <= av
+    nofit_r = req > pot
+    preempt_capable_r = (req <= nom) | st.cq_can_preempt_borrow[cqs][:, None, None]
+    res_nofit = relevant & (nofit_r | (~fit_r & ~preempt_capable_r))
+
+    slot_ok = st.slot_valid[cqs]
+    fit_s = (np.all(np.where(relevant, fit_r, True), axis=2)
+             & ~missing & slot_ok)                          # [W,S]
+    nofit_s = np.any(res_nofit, axis=2) | missing | ~slot_ok
+    preempt_s = ~fit_s & ~nofit_s
+    has_parent = st.parent[cqs] >= 0
+    borrow_r = np.where(relevant, use + req > sq, False)
+    borrows_s = np.any(borrow_r, axis=2) & has_parent[:, None]
+
+    valid = wl_cq >= 0
+    has_fit = np.any(fit_s, axis=1) & valid
+    fit_idx = np.argmax(fit_s, axis=1)
+    fit_slot0 = np.where(has_fit, fit_idx, -1).astype(np.int32)
+    w = np.arange(len(cqs))
+    borrows0 = borrows_s[w, fit_idx] & has_fit
+
+    has_preempt = ~has_fit & np.any(preempt_s, axis=1) & valid
+    p_idx = np.argmax(preempt_s, axis=1)
+    preempt_slot0 = np.where(has_preempt, p_idx, -1).astype(np.int32)
+    preempt_borrows0 = borrows_s[w, p_idx] & has_preempt
+    # per-resource fit on the preempt slot (for frs_need_preemption)
+    preempt_res_fit = fit_r[w, p_idx] | ~relevant[w, p_idx]
+
+    return {
+        "fit_slot0": fit_slot0,
+        "borrows0": borrows0,
+        "preempt0": has_preempt,
+        "preempt_slot0": preempt_slot0,
+        "preempt_borrows0": preempt_borrows0,
+        "preempt_res_fit": preempt_res_fit,
+        "avail0": avail0,
+        "potential0": potential0,
+    }
+
+
+def cycle_order_np(borrows, priority, timestamp) -> np.ndarray:
+    """entryOrdering.Less (scheduler.go:567): borrows asc, priority desc,
+    timestamp asc, stable."""
+    W = len(priority)
+    return np.lexsort((np.arange(W), timestamp, -priority,
+                       borrows.astype(np.int32))).astype(np.int32)
+
+
+# ----------------------------------------------------------------------
+# Device admit scan (fixed assignments; the production phase 2)
+# ----------------------------------------------------------------------
+
+def _entry_decision(avail, usage, wi, valid, *, slot_fr, nominal_cq, npb_cq,
+                    wl_cq, wl_requests, decision_slot, reserve_mask,
+                    reserve_slot, reserve_borrows):
+    """The per-entry decision shared by admit_scan and admit_scan_forests:
+    fixed-slot fit re-check (scheduler.go:372) or capacity reserve
+    (resourcesToReserve, scheduler.go:383-408).
+
+    Returns (admit, node, delta_f): node is the CQ to charge (-1 = no-op)."""
+    wis = jnp.maximum(wi, 0)
+    cq = jnp.maximum(wl_cq[wis], 0)
+    req = wl_requests[wis]
+    F = usage.shape[1]
+
+    slot = decision_slot[wis]
+    is_fit = (slot >= 0) & valid
+    frs = slot_fr[cq, jnp.maximum(slot, 0)]                 # [R]
+    frs_safe = jnp.maximum(frs, 0)
+    relevant = (frs >= 0) & (req > 0)
+    ok = jnp.all(jnp.where(relevant, req <= avail[cq][frs_safe], True))
+    admit = is_fit & ok
+    delta_f = jnp.zeros(F, dtype=usage.dtype).at[frs_safe].add(
+        jnp.where(relevant & admit, req, 0))
+
+    is_res = reserve_mask[wis] & valid
+    rfrs = slot_fr[cq, jnp.maximum(reserve_slot[wis], 0)]
+    rfrs_safe = jnp.maximum(rfrs, 0)
+    rrel = (rfrs >= 0) & (req > 0)
+    cur = usage[cq][rfrs_safe]
+    res_borrow = jnp.minimum(req, npb_cq[cq][rfrs_safe] - cur)
+    res_nob = jnp.maximum(0, jnp.minimum(req, nominal_cq[cq][rfrs_safe] - cur))
+    rdelta = jnp.where(reserve_borrows[wis], res_borrow, res_nob)
+    delta_f = delta_f.at[rfrs_safe].add(
+        jnp.where(rrel & is_res, rdelta, 0))
+
+    node = jnp.where(admit | is_res, wl_cq[wis], -1)
+    return admit, node, delta_f
+
+
+def _admit_step(usage, wi, *, subtree, guaranteed, borrow_cap, has_blim,
+                parent, slot_fr, nominal_cq, npb_cq, wl_cq, wl_requests,
+                decision_slot, reserve_mask, reserve_slot, reserve_borrows,
+                depth):
+    """One cycle-order step: fit re-check + admit, or capacity reserve."""
+    avail = available_all(usage, subtree, guaranteed, borrow_cap,
+                          has_blim, parent, depth)
+    admit, node, delta_f = _entry_decision(
+        avail, usage, wi, wl_cq[wi] >= 0, slot_fr=slot_fr,
+        nominal_cq=nominal_cq, npb_cq=npb_cq, wl_cq=wl_cq,
+        wl_requests=wl_requests, decision_slot=decision_slot,
+        reserve_mask=reserve_mask, reserve_slot=reserve_slot,
+        reserve_borrows=reserve_borrows)
+    usage = add_usage_chain(usage, node, delta_f, guaranteed, parent, depth)
+    return usage, admit
+
+
+@partial(jax.jit, static_argnames=("depth",))
+def admit_scan(usage0, subtree, guaranteed, borrow_cap, has_blim, parent,
+               slot_fr, nominal_cq, npb_cq, wl_cq, wl_requests,
+               decision_slot, reserve_mask, reserve_slot, reserve_borrows,
+               order, *, depth: int):
+    """The sequential admit loop over ``order`` as one lax.scan.
+
+    Returns admitted[W] (original head order).  Decision-identical to the
+    host admit loop for cycles whose preempt entries all have zero
+    preemption candidates (the solver checks that before dispatching)."""
+    W = wl_cq.shape[0]
+    step = partial(_admit_step, subtree=subtree, guaranteed=guaranteed,
+                   borrow_cap=borrow_cap, has_blim=has_blim, parent=parent,
+                   slot_fr=slot_fr, nominal_cq=nominal_cq, npb_cq=npb_cq,
+                   wl_cq=wl_cq, wl_requests=wl_requests,
+                   decision_slot=decision_slot, reserve_mask=reserve_mask,
+                   reserve_slot=reserve_slot,
+                   reserve_borrows=reserve_borrows, depth=depth)
+    _, admit_o = jax.lax.scan(step, usage0, order)
+    return jnp.zeros(W, dtype=bool).at[order].set(admit_o)
+
+
+# ----------------------------------------------------------------------
+# One-call solvers (probe / parity-test surface)
+# ----------------------------------------------------------------------
 
 @partial(jax.jit, static_argnames=("depth", "run_scan"))
 def solve_cycle(usage0, subtree, guaranteed, borrow_cap, has_blim, parent,
@@ -33,45 +233,33 @@ def solve_cycle(usage0, subtree, guaranteed, borrow_cap, has_blim, parent,
     """Returns (admitted[W] bool, slot[W] int32, borrows[W] bool,
     preempt_possible[W] bool, fit_slot0[W] int32, borrows0[W] bool).
 
-    With ``run_scan=False`` only the vectorized phase-1 classification runs
-    (the caller consumes fit_slot0/borrows0 and drives the sequential admit
-    loop host-side); the first three outputs are then zeros."""
+    Phase 1 classifies each head once against the snapshot usage; the scan
+    then admits in cycle order with a fits re-check on the FIXED slot —
+    the reference admit-loop semantics (assignments are never recomputed
+    within a cycle).  With ``run_scan=False`` only phase 1 runs."""
     C = slot_fr.shape[0]
     W = wl_cq.shape[0]
-    S = slot_fr.shape[1]
 
     avail0 = available_all(usage0, subtree, guaranteed, borrow_cap, has_blim,
                            parent, depth)
     potential0 = available_all(jnp.zeros_like(usage0), subtree, guaranteed,
                                borrow_cap, has_blim, parent, depth)
 
-    def classify(avail, usage, wl_cq_i, req):
-        """Per-workload slot classification given avail/usage tensors.
-
-        Returns (fit_slot int32 or -1, borrows bool, preempt_possible bool).
-        """
+    def classify(wl_cq_i, req):
         cq = jnp.maximum(wl_cq_i, 0)
         frs = slot_fr[cq]                       # [S, R]
         frs_safe = jnp.maximum(frs, 0)
-        covered = frs >= 0                      # [S, R]
-        needed = req[None, :] > 0               # [1, R] broadcast
-        # resource requested but not covered by this slot → slot invalid
+        covered = frs >= 0
+        needed = req[None, :] > 0
         missing = jnp.any(needed & ~covered, axis=1)        # [S]
-        av = avail[cq][frs_safe]                # [S, R] gather over F
+        av = avail0[cq][frs_safe]               # [S, R]
         pot = potential0[cq][frs_safe]
         nom = nominal_cq[cq][frs_safe]
-        use = usage[cq][frs_safe]               # CQ-local usage (for borrow calc)
+        use = usage0[cq][frs_safe]
         sq = subtree[cq][frs_safe]
 
-        # Per-resource mode lattice (flavorassigner.go:692 fitsResourceQuota,
-        # evaluated per resource; the slot's representative mode is the min):
-        #   fit:     req <= available
-        #   nofit:   req > potentialAvailable, or neither fit nor
-        #            preempt-capable
-        #   preempt: otherwise, if req <= nominal or the CQ may preempt
-        #            while borrowing
         relevant = covered & needed
-        fit_r = req[None, :] <= av              # [S, R]
+        fit_r = req[None, :] <= av
         nofit_r = req[None, :] > pot
         preempt_capable_r = (req[None, :] <= nom) | cq_can_preempt_borrow[cq]
         res_nofit = relevant & (nofit_r | (~fit_r & ~preempt_capable_r))
@@ -80,13 +268,10 @@ def solve_cycle(usage0, subtree, guaranteed, borrow_cap, has_blim, parent,
                & ~missing & slot_valid[cq])     # [S]
         nofit = jnp.any(res_nofit, axis=1) | missing | ~slot_valid[cq]
         preempt = ~fit & ~nofit
-        # borrowing: usage + req would exceed the CQ's own subtree quota,
-        # and the CQ is in a cohort (clusterqueue_snapshot.go BorrowingWith)
         has_parent = parent[cq] >= 0
         borrow_r = jnp.where(relevant, use + req[None, :] > sq, False)
         borrows_s = jnp.any(borrow_r, axis=1) & has_parent   # [S]
 
-        # default fungibility: first Fit slot wins (whenCanBorrow=Borrow)
         fit_idx = jnp.argmax(fit)
         has_fit = jnp.any(fit)
         fit_slot = jnp.where(has_fit, fit_idx, -1)
@@ -97,45 +282,23 @@ def solve_cycle(usage0, subtree, guaranteed, borrow_cap, has_blim, parent,
                 borrows & valid,
                 preempt_possible & valid)
 
-    fit_slot0, borrows0, preempt0 = jax.vmap(
-        lambda c, r: classify(avail0, usage0, c, r))(wl_cq, wl_requests)
+    fit_slot0, borrows0, preempt0 = jax.vmap(classify)(wl_cq, wl_requests)
 
     if not run_scan:
         zeros_b = jnp.zeros(W, dtype=bool)
         zeros_i = jnp.full(W, -1, dtype=jnp.int32)
         return zeros_b, zeros_i, zeros_b, preempt0, fit_slot0, borrows0
 
-    # --- ordering: borrows asc, priority desc, timestamp asc, index asc ---
     order = jnp.lexsort((jnp.arange(W), wl_timestamp, -wl_priority,
                          borrows0.astype(jnp.int32)))
-
-    # --- sequential admit scan ---
-    def step(usage, wi):
-        wl_cq_i = wl_cq[wi]
-        req = wl_requests[wi]
-        avail = available_all(usage, subtree, guaranteed, borrow_cap,
-                              has_blim, parent, depth)
-        fit_slot, borrows, _ = classify(avail, usage, wl_cq_i, req)
-        admit = fit_slot >= 0
-        # scatter request into F space for the chosen slot
-        cq = jnp.maximum(wl_cq_i, 0)
-        frs = slot_fr[cq][jnp.maximum(fit_slot, 0)]      # [R]
-        delta_f = jnp.zeros(usage.shape[1], dtype=usage.dtype)
-        delta_f = delta_f.at[jnp.maximum(frs, 0)].add(
-            jnp.where((frs >= 0) & admit, req, 0))
-        new_usage = add_usage_chain(usage, cq, delta_f, guaranteed, parent,
-                                    depth)
-        usage = jnp.where(admit, new_usage, usage)
-        return usage, (wi, admit, fit_slot, borrows)
-
-    _, (order_out, admit_o, slot_o, borrows_o) = jax.lax.scan(
-        step, usage0, order)
-
-    # scatter back to original W order
-    admitted = jnp.zeros(W, dtype=bool).at[order_out].set(admit_o)
-    slots = jnp.full(W, -1, dtype=jnp.int32).at[order_out].set(slot_o)
-    borrows = jnp.zeros(W, dtype=bool).at[order_out].set(borrows_o)
-
+    no_reserve = jnp.zeros(W, dtype=bool)
+    admitted = admit_scan(
+        usage0, subtree, guaranteed, borrow_cap, has_blim, parent, slot_fr,
+        nominal_cq, jnp.zeros_like(nominal_cq), wl_cq, wl_requests,
+        fit_slot0, no_reserve, jnp.zeros(W, dtype=jnp.int32), no_reserve,
+        order, depth=depth)
+    slots = jnp.where(admitted, fit_slot0, -1).astype(jnp.int32)
+    borrows = borrows0 & admitted
     return admitted, slots, borrows, preempt0, fit_slot0, borrows0
 
 
@@ -162,40 +325,10 @@ def add_usage_chain_batched(usage, nodes, deltas, guaranteed, parent,
     return usage
 
 
-@partial(jax.jit, static_argnames=("depth", "n_forests", "max_forest_wl"))
-def solve_cycle_forests(usage0, subtree, guaranteed, borrow_cap, has_blim,
-                        parent, nominal_cq, slot_fr, slot_valid,
-                        cq_can_preempt_borrow, wl_cq, wl_requests,
-                        wl_priority, wl_timestamp, forest_of_node,
-                        *, depth: int, n_forests: int, max_forest_wl: int):
-    """The admit scan parallelized over independent cohort forests.
-
-    Quota never flows between forests, so the sequential within-cycle
-    semantics only constrain workloads of the SAME forest; each scan step
-    admits one workload per forest simultaneously (scatter-adds on
-    disjoint chains).  Scan length drops from W to max_forest_wl — the
-    lever that takes the north-star 1k-head cycle from O(heads) to
-    O(heads / forests) (SURVEY §7 hard part (a), exploited structurally).
-
-    Decision-identical to solve_cycle(run_scan=True); enforced by
-    tests/test_forest_scan.py."""
-    W = wl_cq.shape[0]
-    G = n_forests + 1                       # + padding bucket
-
-    # phase 1 + global ordering (identical to solve_cycle)
-    _, _, _, preempt0, fit_slot0, borrows0 = solve_cycle(
-        usage0, subtree, guaranteed, borrow_cap, has_blim, parent,
-        nominal_cq, slot_fr, slot_valid, cq_can_preempt_borrow,
-        wl_cq, wl_requests, wl_priority, wl_timestamp,
-        depth=depth, run_scan=False)
-    order = jnp.lexsort((jnp.arange(W), wl_timestamp, -wl_priority,
-                         borrows0.astype(jnp.int32)))
+def _forest_schedule(order, f_w, W, G, max_forest_wl):
+    """Group entries by forest, cycle order within each group → [G, L]."""
     inv_order = jnp.zeros(W, dtype=jnp.int32).at[order].set(
         jnp.arange(W, dtype=jnp.int32))
-
-    f_w = jnp.where(wl_cq >= 0,
-                    forest_of_node[jnp.maximum(wl_cq, 0)], n_forests)
-    # group by forest, cycle order within each group
     p = jnp.lexsort((inv_order, f_w))                    # [W]
     f_sorted = f_w[p]
     first = jnp.concatenate([jnp.array([True]),
@@ -206,66 +339,80 @@ def solve_cycle_forests(usage0, subtree, guaranteed, borrow_cap, has_blim,
     rank = (pos - seg_start).astype(jnp.int32)           # in-forest rank
     mat = jnp.full((G, max_forest_wl), -1, dtype=jnp.int32)
     # ranks beyond max_forest_wl are dropped (host sizes the bucket)
-    mat = mat.at[f_sorted, rank].set(p.astype(jnp.int32), mode="drop")
+    return mat.at[f_sorted, rank].set(p.astype(jnp.int32), mode="drop")
 
-    def classify_g(avail, usage, wi):
-        """Per-forest step: classify workload wi (or -1)."""
-        wl_cq_i = jnp.where(wi >= 0, wl_cq[jnp.maximum(wi, 0)], -1)
-        valid = wl_cq_i >= 0
-        req = wl_requests[jnp.maximum(wi, 0)]
-        # reuse the classification from solve_cycle via a fresh pass
-        cq = jnp.maximum(wl_cq_i, 0)
-        frs = slot_fr[cq]
-        frs_safe = jnp.maximum(frs, 0)
-        covered = frs >= 0
-        needed = req[None, :] > 0
-        missing = jnp.any(needed & ~covered, axis=1)
-        av = avail[cq][frs_safe]
-        nom = nominal_cq[cq][frs_safe]
-        use = usage[cq][frs_safe]
-        sq = subtree[cq][frs_safe]
-        relevant = covered & needed
-        fit_r = req[None, :] <= av
-        fit = (jnp.all(jnp.where(relevant, fit_r, True), axis=1)
-               & ~missing & slot_valid[cq])
-        has_parent = parent[cq] >= 0
-        borrow_r = jnp.where(relevant, use + req[None, :] > sq, False)
-        borrows_s = jnp.any(borrow_r, axis=1) & has_parent
-        fit_idx = jnp.argmax(fit)
-        has_fit = jnp.any(fit) & valid
-        fit_slot = jnp.where(has_fit, fit_idx, -1)
-        borrows = jnp.where(has_fit, borrows_s[fit_idx], False)
-        return fit_slot, borrows
+
+@partial(jax.jit, static_argnames=("depth", "n_forests", "max_forest_wl"))
+def admit_scan_forests(usage0, subtree, guaranteed, borrow_cap, has_blim,
+                       parent, slot_fr, nominal_cq, npb_cq, wl_cq,
+                       wl_requests, decision_slot, reserve_mask,
+                       reserve_slot, reserve_borrows, order, forest_of_node,
+                       *, depth: int, n_forests: int, max_forest_wl: int):
+    """``admit_scan`` parallelized over independent cohort forests.
+
+    Quota never flows between forests, so the sequential within-cycle
+    semantics only constrain workloads of the SAME forest; each scan step
+    processes one workload per forest simultaneously (scatter-adds on
+    disjoint chains).  Scan length drops from W to max_forest_wl — the
+    lever that takes a 1k-head cycle from O(heads) to O(heads / forests).
+    Decision-identical to admit_scan (tests/test_forest_scan.py)."""
+    W = wl_cq.shape[0]
+    G = n_forests + 1                       # + padding bucket
+
+    f_w = jnp.where(wl_cq >= 0,
+                    forest_of_node[jnp.maximum(wl_cq, 0)], n_forests)
+    mat = _forest_schedule(order, f_w, W, G, max_forest_wl)
 
     def step(usage, col):
         wis = mat[:, col]                                # [G]
         avail = available_all(usage, subtree, guaranteed, borrow_cap,
                               has_blim, parent, depth)
-        fit_slot, borrows = jax.vmap(
-            lambda wi: classify_g(avail, usage, wi))(wis)
-        admit = fit_slot >= 0
-        cqs = jnp.where(admit, wl_cq[jnp.maximum(wis, 0)], -1)
-        frs = slot_fr[jnp.maximum(cqs, 0),
-                      jnp.maximum(fit_slot, 0)]          # [G, R]
-        reqs = wl_requests[jnp.maximum(wis, 0)]          # [G, R]
-        deltas = jnp.zeros((G, usage.shape[1]), dtype=usage.dtype)
-        deltas = deltas.at[jnp.arange(G)[:, None],
-                           jnp.maximum(frs, 0)].add(
-            jnp.where((frs >= 0) & admit[:, None], reqs, 0))
-        usage = add_usage_chain_batched(usage, cqs, deltas, guaranteed,
+        admit, nodes, deltas = jax.vmap(
+            lambda wi: _entry_decision(
+                avail, usage, wi,
+                (wi >= 0) & (wl_cq[jnp.maximum(wi, 0)] >= 0),
+                slot_fr=slot_fr, nominal_cq=nominal_cq, npb_cq=npb_cq,
+                wl_cq=wl_cq, wl_requests=wl_requests,
+                decision_slot=decision_slot, reserve_mask=reserve_mask,
+                reserve_slot=reserve_slot,
+                reserve_borrows=reserve_borrows))(wis)
+        usage = add_usage_chain_batched(usage, nodes, deltas, guaranteed,
                                         parent, depth)
-        return usage, (wis, admit, fit_slot, borrows)
+        return usage, (wis, admit)
 
-    _, (wis_o, admit_o, slot_o, borrows_o) = jax.lax.scan(
-        step, usage0, jnp.arange(max_forest_wl))
+    _, (wis_o, admit_o) = jax.lax.scan(step, usage0,
+                                       jnp.arange(max_forest_wl))
 
     wis_flat = wis_o.reshape(-1)
     safe = jnp.maximum(wis_flat, 0)
     mask = wis_flat >= 0
     admitted = jnp.zeros(W, dtype=bool).at[safe].max(
         admit_o.reshape(-1) & mask)
-    slots = jnp.full(W, -1, dtype=jnp.int32).at[safe].max(
-        jnp.where(mask, slot_o.reshape(-1), -1))
-    borrows = jnp.zeros(W, dtype=bool).at[safe].max(
-        borrows_o.reshape(-1) & mask)
+    return admitted
+
+
+@partial(jax.jit, static_argnames=("depth", "n_forests", "max_forest_wl"))
+def solve_cycle_forests(usage0, subtree, guaranteed, borrow_cap, has_blim,
+                        parent, nominal_cq, slot_fr, slot_valid,
+                        cq_can_preempt_borrow, wl_cq, wl_requests,
+                        wl_priority, wl_timestamp, forest_of_node,
+                        *, depth: int, n_forests: int, max_forest_wl: int):
+    """One-call phase 1 + forest-parallel admit scan (probe surface)."""
+    W = wl_cq.shape[0]
+    _, _, _, preempt0, fit_slot0, borrows0 = solve_cycle(
+        usage0, subtree, guaranteed, borrow_cap, has_blim, parent,
+        nominal_cq, slot_fr, slot_valid, cq_can_preempt_borrow,
+        wl_cq, wl_requests, wl_priority, wl_timestamp,
+        depth=depth, run_scan=False)
+    order = jnp.lexsort((jnp.arange(W), wl_timestamp, -wl_priority,
+                         borrows0.astype(jnp.int32))).astype(jnp.int32)
+    no_reserve = jnp.zeros(W, dtype=bool)
+    admitted = admit_scan_forests(
+        usage0, subtree, guaranteed, borrow_cap, has_blim, parent, slot_fr,
+        nominal_cq, jnp.zeros_like(nominal_cq), wl_cq, wl_requests,
+        fit_slot0, no_reserve, jnp.zeros(W, dtype=jnp.int32), no_reserve,
+        order, forest_of_node, depth=depth, n_forests=n_forests,
+        max_forest_wl=max_forest_wl)
+    slots = jnp.where(admitted, fit_slot0, -1).astype(jnp.int32)
+    borrows = borrows0 & admitted
     return admitted, slots, borrows, preempt0, fit_slot0, borrows0

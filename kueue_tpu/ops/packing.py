@@ -10,10 +10,20 @@ shaped integer tensors (SURVEY §7 stage 1).  Axes:
 - S: flavor slots per resource group (max flavor-list length)
 - R: distinct resource names
 
+The codec is split in two so the per-cycle cost is O(usage + heads), not
+O(cluster):
+
+- ``PackedStructure`` — everything derived from specs (quota tensors,
+  flavor slots, the cohort forest, int32 scaling).  Rebuilt only when the
+  cache's structure generation changes (a CQ/cohort/flavor apply), and
+  cached by the solver across cycles.
+- ``pack_cycle`` — fills the per-cycle usage [N, F] and workload [W, R]
+  tensors against a cached structure.
+
 Quantities are canonical integers scaled per-resource so that everything
-fits int32 (TPU-native); the packer asserts exact divisibility and falls
-back to ceil-scaling requests (conservative) otherwise.  int64 milli-quanta
-on TPU is hard part (e) in SURVEY §7.
+fits int32 (TPU-native); per-cycle values that don't divide the cached
+scale mark the pack inexact and the solver defers to the host (int64
+milli-quanta on TPU is hard part (e) in SURVEY §7).
 """
 
 from __future__ import annotations
@@ -31,32 +41,49 @@ from ..workload import Info
 
 INT_INF = np.int64(2**62)  # "no limit" sentinel before scaling
 I32_MAX = 2**31 - 1
+_LIMIT = I32_MAX // 64     # ×64 headroom for sums across the tree
+
+
+@dataclass
+class PackedStructure:
+    """Static cluster structure: valid while the cache structure
+    generation is unchanged (no CQ/cohort/flavor spec edits)."""
+    generation: int
+    cq_names: list[str]
+    cohort_names: list[str]
+    node_count: int                      # N = len(cq_names) + cohorts
+    parent: np.ndarray                   # [N] int32, -1 for roots
+    depth: int
+    fr_index: dict[FlavorResource, int]  # (flavor, resource) -> F
+    resource_names: list[str]            # R axis
+    r_index: dict[str, int]
+    resource_scale: np.ndarray           # [R] int64 divisor per resource
+    scale_is_one: bool
+    exact_static: bool                   # static tensors scaled losslessly
+
+    subtree_quota: np.ndarray            # [N, F] int32 (scaled)
+    guaranteed: np.ndarray               # [N, F] int32
+    borrow_cap: np.ndarray               # [N, F] int32
+    has_borrow_limit: np.ndarray         # [N, F] bool
+    nominal_cq: np.ndarray               # [C, F] int32
+    nominal_plus_blimit_cq: np.ndarray   # [C, F] int32 (INT "inf" when unlimited)
+    slot_fr: np.ndarray                  # [C, S, R] int32 F-index or -1
+    slot_valid: np.ndarray               # [C, S] bool
+    slot_count_cq: np.ndarray            # [C] int32: len(rg.flavors)
+    cq_can_preempt_borrow: np.ndarray    # [C] bool
+    fair_weight_milli: np.ndarray        # [N] int32
+    forest_of_node: np.ndarray           # [N] int32
+    n_forests: int
+    cq_index: dict[str, int] = field(default_factory=dict)
+    cq_covers_pods: set = field(default_factory=set)
 
 
 @dataclass
 class PackedCycle:
-    # --- static cluster structure ---
-    cq_names: list[str]
-    node_count: int                      # N = len(cq_names) + cohorts
-    parent: np.ndarray                   # [N] int32, -1 for roots
-    depth: int                           # max tree depth (levels of parent hops)
-    fr_index: dict[FlavorResource, int]  # (flavor, resource) -> F
-    resource_names: list[str]            # R axis
-    resource_scale: np.ndarray           # [R] int64 divisor per resource
+    """A cycle = structure + per-cycle usage and workload tensors."""
+    structure: PackedStructure
 
-    subtree_quota: np.ndarray            # [N, F] int32 (scaled)
-    guaranteed: np.ndarray               # [N, F] int32
-    borrow_cap: np.ndarray               # [N, F] int32: stored_in_parent + blimit (clipped)
-    has_borrow_limit: np.ndarray         # [N, F] bool
     usage0: np.ndarray                   # [N, F] int32: usage at snapshot time
-
-    # flavor machinery: per CQ, per resource, ordered flavor slots -> F index
-    slot_fr: np.ndarray                  # [C, S, R] int32 F-index or -1
-    slot_valid: np.ndarray               # [C, S] bool (flavor exists & allowed)
-    nominal_cq: np.ndarray               # [C, F] int32 (for preempt classification)
-    cq_can_preempt_borrow: np.ndarray    # [C] bool: canPreemptWhileBorrowing
-
-    # --- per-cycle workloads ---
     wl_count: int                        # true number of heads (<= W)
     wl_cq: np.ndarray                    # [W] int32 CQ index (-1 pad)
     wl_requests: np.ndarray              # [W, R] int32 total requests (scaled)
@@ -64,9 +91,44 @@ class PackedCycle:
     wl_timestamp: np.ndarray             # [W] float64 queue-order timestamp
     wl_keys: list[str] = field(default_factory=list)
     exact: bool = True                   # scaled comparisons are lossless
-    fair_weight_milli: np.ndarray = None  # [N] int32 (fair sharing)
-    forest_of_node: np.ndarray = None    # [N] int32 root-forest id
-    n_forests: int = 0
+
+    # --- structure passthroughs (stable codec surface) ---
+    @property
+    def cq_names(self): return self.structure.cq_names
+    @property
+    def node_count(self): return self.structure.node_count
+    @property
+    def parent(self): return self.structure.parent
+    @property
+    def depth(self): return self.structure.depth
+    @property
+    def fr_index(self): return self.structure.fr_index
+    @property
+    def resource_names(self): return self.structure.resource_names
+    @property
+    def resource_scale(self): return self.structure.resource_scale
+    @property
+    def subtree_quota(self): return self.structure.subtree_quota
+    @property
+    def guaranteed(self): return self.structure.guaranteed
+    @property
+    def borrow_cap(self): return self.structure.borrow_cap
+    @property
+    def has_borrow_limit(self): return self.structure.has_borrow_limit
+    @property
+    def nominal_cq(self): return self.structure.nominal_cq
+    @property
+    def slot_fr(self): return self.structure.slot_fr
+    @property
+    def slot_valid(self): return self.structure.slot_valid
+    @property
+    def cq_can_preempt_borrow(self): return self.structure.cq_can_preempt_borrow
+    @property
+    def fair_weight_milli(self): return self.structure.fair_weight_milli
+    @property
+    def forest_of_node(self): return self.structure.forest_of_node
+    @property
+    def n_forests(self): return self.structure.n_forests
 
 
 def _bucket(n: int, minimum: int = 8) -> int:
@@ -102,29 +164,75 @@ def snapshot_fair_sharing(snapshot: Snapshot) -> bool:
     return bool(getattr(snapshot, "fair_sharing_enabled", False))
 
 
-def pack_cycle(snapshot: Snapshot, heads: list[Info],
-               ordering=None) -> PackedCycle:
+def _snapshot_nodes(snapshot: Snapshot, structure: PackedStructure):
+    """Resolve the structure's node order against a fresh snapshot, or
+    None if the topology changed under us (caller rebuilds)."""
+    by_name: dict[str, CohortState] = {}
+
+    def walk(c: CohortState):
+        by_name[c.name] = c
+        for ch in c.child_cohorts:
+            walk(ch)
+
+    for root in snapshot.roots:
+        walk(root)
+    nodes = []
+    for name in structure.cq_names:
+        cq = snapshot.cluster_queues.get(name)
+        if cq is None:
+            return None
+        nodes.append(cq)
+    for name in structure.cohort_names:
+        c = by_name.get(name)
+        if c is None:
+            return None
+        nodes.append(c)
+    return nodes
+
+
+def _choose_scale(max_val: int, gcd_val: int) -> tuple[int, bool]:
+    """Pick a per-resource divisor so max_val/scale fits int32 with
+    headroom.  Prefer a scale dividing every observed static value (exact);
+    fall back to a power of two marked inexact (hard part (e))."""
+    if max_val <= _LIMIT:
+        return 1, True
+    need = -(-max_val // _LIMIT)          # ceil
+    p2 = 1
+    while p2 < need:
+        p2 *= 2
+    cand = math.gcd(int(gcd_val), p2 * (1 << 20))  # pow2 component of gcd
+    if cand >= need and max_val // cand <= _LIMIT:
+        return cand, True
+    if gcd_val >= need and max_val // gcd_val <= _LIMIT:
+        return int(gcd_val), True
+    scale = p2
+    while max_val // scale > _LIMIT:
+        scale *= 2
+    return scale, gcd_val % scale == 0
+
+
+def pack_structure(snapshot: Snapshot, heads: list[Info] = (),
+                   generation: int = -1) -> PackedStructure:
+    """Build the static structure tensors from a snapshot.  ``heads``
+    (optional) contributes request quantities to the scale choice so a
+    one-shot pack stays exact."""
     cq_names, cohorts = _iter_nodes(snapshot)
+    cohort_names = [c.name for c in cohorts]
     cq_idx = {n: i for i, n in enumerate(cq_names)}
-    cohort_idx = {id(c): len(cq_names) + i for i, c in enumerate(cohorts)}
     C = len(cq_names)
     N = C + len(cohorts)
 
-    # F axis
+    nodes: list = [snapshot.cluster_queues[n] for n in cq_names] + cohorts
+
+    # F axis: quota frs ∪ current usage frs
     frs: set[FlavorResource] = set()
-    for name in cq_names:
-        cq = snapshot.cluster_queues[name]
-        frs.update(cq.resource_node.quotas)
-        frs.update(cq.resource_node.usage)
-    for c in cohorts:
-        frs.update(c.resource_node.quotas)
-        frs.update(c.resource_node.usage)
+    for node in nodes:
+        frs.update(node.resource_node.quotas)
+        frs.update(node.resource_node.usage)
     fr_list = sorted(frs)
     fr_index = {fr: i for i, fr in enumerate(fr_list)}
     F = max(1, len(fr_list))
 
-    # CQs whose resource groups cover the implicit "pods" resource get
-    # requests[pods] = pod count injected (flavorassigner.go:226).
     cq_covers_pods = {
         name for name in cq_names
         if any("pods" in rg.covered_resources
@@ -148,7 +256,6 @@ def pack_cycle(snapshot: Snapshot, heads: list[Info],
             max_per_resource[i] = max(max_per_resource[i], av)
             gcd_per_resource[i] = math.gcd(int(gcd_per_resource[i]), av)
 
-    nodes: list = [snapshot.cluster_queues[n] for n in cq_names] + cohorts
     for node in nodes:
         for fr, q in node.resource_node.quotas.items():
             note(fr.resource, q.nominal)
@@ -163,44 +270,32 @@ def pack_cycle(snapshot: Snapshot, heads: list[Info],
             for r, v in psr.requests.items():
                 note(r, v)
 
-    # Exact scaling: divide by the GCD of every observed quantity, so
-    # scaled comparisons are bit-identical to the host's (hard part (e),
-    # SURVEY §7).  If even GCD scaling can't fit int32 (with ×64 headroom
-    # for sums across the tree), fall back to lossy power-of-two scaling
-    # and mark the pack inexact — the solver then defers to the host.
     scale = np.ones(R, dtype=np.int64)
-    exact = True
-    limit = I32_MAX // 64
+    exact_static = True
     for i in range(R):
-        if max_per_resource[i] <= limit:
-            continue
-        scale[i] = max(1, int(gcd_per_resource[i]))
-        while max_per_resource[i] // scale[i] > limit:
-            scale[i] *= 2
-            exact = False
+        s, ok = _choose_scale(int(max_per_resource[i]),
+                              int(gcd_per_resource[i]))
+        scale[i] = s
+        exact_static = exact_static and ok
+    scale_is_one = bool((scale == 1).all())
 
     def scaled(r: str, v) -> int:
         if v >= INT_INF:
-            return int(I32_MAX // 64)
+            return int(_LIMIT)
         s = int(scale[r_index[r]])
         return int(v) // s if v >= 0 else -((-int(v)) // s)
-
-    def scaled_ceil(r: str, v) -> int:
-        if v >= INT_INF:
-            return int(I32_MAX // 64)
-        s = int(scale[r_index[r]])
-        return -((-int(v)) // s)
 
     # node tensors
     subtree = np.zeros((N, F), dtype=np.int32)
     guaranteed = np.zeros((N, F), dtype=np.int32)
-    borrow_cap = np.full((N, F), int(I32_MAX // 64), dtype=np.int32)
+    borrow_cap = np.full((N, F), int(_LIMIT), dtype=np.int32)
     has_blim = np.zeros((N, F), dtype=bool)
-    usage0 = np.zeros((N, F), dtype=np.int32)
     parent = np.full(N, -1, dtype=np.int32)
     nominal_cq = np.zeros((C, F), dtype=np.int32)
-
+    nominal_plus_blimit = np.full((C, F), int(_LIMIT), dtype=np.int32)
     fair_weight = np.full(N, 1000, dtype=np.int32)
+
+    cohort_idx = {id(c): C + i for i, c in enumerate(cohorts)}
     for ni, node in enumerate(nodes):
         p = node.parent
         parent[ni] = cohort_idx[id(p)] if p is not None else -1
@@ -210,10 +305,12 @@ def pack_cycle(snapshot: Snapshot, heads: list[Info],
             sq = rn.subtree_quota.get(fr, 0)
             subtree[ni, fi] = scaled(fr.resource, sq)
             guaranteed[ni, fi] = scaled(fr.resource, rn.guaranteed_quota(fr))
-            usage0[ni, fi] = scaled_ceil(fr.resource, rn.usage.get(fr, 0))
             q = rn.quotas.get(fr)
             if ni < C and q is not None:
                 nominal_cq[ni, fi] = scaled(fr.resource, q.nominal)
+                if q.borrowing_limit is not None:
+                    nominal_plus_blimit[ni, fi] = scaled(
+                        fr.resource, q.nominal + q.borrowing_limit)
             if q is not None and q.borrowing_limit is not None:
                 has_blim[ni, fi] = True
                 stored = sq - rn.guaranteed_quota(fr)
@@ -241,6 +338,7 @@ def pack_cycle(snapshot: Snapshot, heads: list[Info],
             S = max(S, len(rg.flavors))
     slot_fr = np.full((C, S, R), -1, dtype=np.int32)
     slot_valid = np.zeros((C, S), dtype=bool)
+    slot_count = np.zeros(C, dtype=np.int32)
     cq_can_preempt_borrow = np.zeros(C, dtype=bool)
     from ..api.types import BorrowWithinCohortPolicy, ReclaimWithinCohort
     for ci, name in enumerate(cq_names):
@@ -252,6 +350,7 @@ def pack_cycle(snapshot: Snapshot, heads: list[Info],
     for ci, name in enumerate(cq_names):
         cq = snapshot.cluster_queues[name]
         for rg in cq.spec.resource_groups:
+            slot_count[ci] = max(slot_count[ci], len(rg.flavors))
             for si, fq in enumerate(rg.flavors):
                 exists = fq.name in snapshot.resource_flavors
                 slot_valid[ci, si] = slot_valid[ci, si] or exists
@@ -261,37 +360,108 @@ def pack_cycle(snapshot: Snapshot, heads: list[Info],
                         if fr in fr_index and exists:
                             slot_fr[ci, si, r_index[rname]] = fr_index[fr]
 
-    # workloads
+    return PackedStructure(
+        generation=generation, cq_names=cq_names, cohort_names=cohort_names,
+        node_count=N, parent=parent, depth=depth, fr_index=fr_index,
+        resource_names=resource_names, r_index=r_index,
+        resource_scale=scale, scale_is_one=scale_is_one,
+        exact_static=exact_static,
+        subtree_quota=subtree, guaranteed=guaranteed, borrow_cap=borrow_cap,
+        has_borrow_limit=has_blim, nominal_cq=nominal_cq,
+        nominal_plus_blimit_cq=nominal_plus_blimit,
+        slot_fr=slot_fr, slot_valid=slot_valid, slot_count_cq=slot_count,
+        cq_can_preempt_borrow=cq_can_preempt_borrow,
+        fair_weight_milli=fair_weight, forest_of_node=forest_of_node,
+        n_forests=n_forests, cq_index=cq_idx, cq_covers_pods=cq_covers_pods,
+    )
+
+
+def pack_cycle(snapshot: Snapshot, heads: list[Info], ordering=None,
+               structure: Optional[PackedStructure] = None
+               ) -> Optional[PackedCycle]:
+    """Fill the per-cycle tensors.  With a cached ``structure`` this is
+    O(usage entries + heads); without one the structure is built fresh
+    (one-shot codec, used by tests/probes).
+
+    Returns None when the cached structure no longer describes the
+    snapshot (new flavor-resource or node appeared) — the caller rebuilds
+    and retries."""
+    fresh = structure is None
+    if fresh:
+        structure = pack_structure(snapshot, heads)
+    st = structure
+    nodes = _snapshot_nodes(snapshot, st)
+    if nodes is None:
+        return None
+
+    N, F = st.node_count, max(1, len(st.fr_index))
+    R = len(st.resource_names)
+    scale = st.resource_scale
+    exact = st.exact_static
+
+    usage0 = np.zeros((N, F), dtype=np.int32)
+    if st.scale_is_one:
+        for ni, node in enumerate(nodes):
+            for fr, v in node.resource_node.usage.items():
+                fi = st.fr_index.get(fr)
+                if fi is None:
+                    return None
+                usage0[ni, fi] = v
+    else:
+        for ni, node in enumerate(nodes):
+            for fr, v in node.resource_node.usage.items():
+                fi = st.fr_index.get(fr)
+                if fi is None:
+                    return None
+                s = int(scale[st.r_index[fr.resource]])
+                q, rem = divmod(int(v), s)
+                if rem:
+                    exact = False
+                    q += 1  # conservative ceil
+                usage0[ni, fi] = q
+
     W = _bucket(len(heads))
     wl_cq = np.full(W, -1, dtype=np.int32)
-    wl_requests = np.zeros((W, R), dtype=np.int32)
+    # accumulate in int64: a cached structure's scale was chosen without
+    # this cycle's requests, so scaled sums may exceed int32 — that marks
+    # the pack inexact (host fallback) instead of wrapping
+    wl_requests64 = np.zeros((W, R), dtype=np.int64)
     wl_priority = np.zeros(W, dtype=np.int32)
     wl_timestamp = np.zeros(W, dtype=np.float64)
     wl_keys = []
     for wi, h in enumerate(heads):
         wl_keys.append(h.key)
-        wl_cq[wi] = cq_idx.get(h.cluster_queue, -1)
+        wl_cq[wi] = st.cq_index.get(h.cluster_queue, -1)
+        covers_pods = h.cluster_queue in st.cq_covers_pods
         for psr in h.total_requests:
             for r, v in psr.requests.items():
                 # the implicit "pods" request only participates when the
                 # head's CQ covers it (flavorassigner.go:226)
-                if r == "pods" and h.cluster_queue not in cq_covers_pods:
+                if r == "pods" and not covers_pods:
                     continue
-                wl_requests[wi, r_index[r]] += scaled_ceil(r, v)
+                ri = st.r_index.get(r)
+                if ri is None:
+                    return None
+                if st.scale_is_one:
+                    wl_requests64[wi, ri] += int(v)
+                else:
+                    s = int(scale[ri])
+                    q, rem = divmod(int(v), s)
+                    if rem:
+                        exact = False
+                        q += 1
+                    wl_requests64[wi, ri] += q
         wl_priority[wi] = h.obj.priority
         wl_timestamp[wi] = (ordering.queue_order_timestamp(h.obj)
                             if ordering is not None else h.obj.creation_time)
+    if wl_requests64.max(initial=0) > _LIMIT:
+        exact = False
+        np.clip(wl_requests64, None, _LIMIT, out=wl_requests64)
+    wl_requests = wl_requests64.astype(np.int32)
 
     return PackedCycle(
-        cq_names=cq_names, node_count=N, parent=parent, depth=depth,
-        fr_index=fr_index, resource_names=resource_names,
-        resource_scale=scale,
-        subtree_quota=subtree, guaranteed=guaranteed,
-        borrow_cap=borrow_cap, has_borrow_limit=has_blim, usage0=usage0,
-        slot_fr=slot_fr, slot_valid=slot_valid, nominal_cq=nominal_cq,
-        cq_can_preempt_borrow=cq_can_preempt_borrow,
+        structure=st, usage0=usage0,
         wl_count=len(heads), wl_cq=wl_cq, wl_requests=wl_requests,
         wl_priority=wl_priority, wl_timestamp=wl_timestamp, wl_keys=wl_keys,
-        exact=exact, fair_weight_milli=fair_weight,
-        forest_of_node=forest_of_node, n_forests=n_forests,
+        exact=exact,
     )

@@ -1,52 +1,215 @@
 """Host wrapper for the device cycle solver.
 
-Packs a (snapshot, heads) pair, invokes the jitted batched cycle
-(kueue_tpu.ops.cycle), and converts results back into Assignment objects
-compatible with the scalar scheduler path.  Falls back (returns None) when
-the cycle needs semantics not yet on device: preemption candidates, TAS
-requests, fair sharing, non-default fungibility, multi-resource-group CQs,
-or admission-check strategies — the host path then runs, keeping decisions
-bit-identical.
+Per cycle the solver:
+
+1. packs (snapshot, heads) against a CACHED ``PackedStructure`` — the
+   static cluster tensors are rebuilt only when the cache structure
+   generation changes, so the per-cycle cost is O(usage + heads);
+2. runs the vectorized nominate (``ops.cycle.classify_np``) on the host —
+   no device round-trip for phase 1;
+3. dispatches the sequential admit scan (``ops.cycle.admit_scan``) as ONE
+   jitted program, routed to the accelerator for large cycles and to the
+   XLA CPU backend for small ones (a tunneled-TPU round trip costs ~100 ms
+   flat, so small cycles can't amortize it — the kernel is identical on
+   both backends).
+
+Falls back (returns None) when the cycle needs semantics not yet on
+device: TAS requests, fair sharing, non-default fungibility,
+multi-resource-group CQs, taints/affinity, or inexact int32 scaling — the
+host path then runs, keeping decisions bit-identical.
 """
 
 from __future__ import annotations
 
+import os
+from dataclasses import dataclass
 from typing import Optional
 
 import numpy as np
 
-from ..api.types import FlavorFungibility, FlavorFungibilityPolicy
+from ..api.types import FlavorFungibility
 from ..cache.snapshot import Snapshot
 from ..workload import Info, Ordering
 from ..scheduler.flavorassigner import (
     Assignment,
+    AssignmentClusterQueueState,
     FlavorAssignmentDecision,
     Mode,
     PodSetAssignmentResult,
 )
-from ..resources import FlavorResource, Requests
-from .packing import pack_cycle
-from .cycle import solve_cycle
+from ..resources import FlavorResource, FlavorResourceQuantities, Requests
+from .packing import PackedCycle, PackedStructure, pack_cycle, pack_structure
+from .cycle import admit_scan, classify_np, cycle_order_np
 
 _DEFAULT_FF = FlavorFungibility()
 
 
-class CycleSolver:
-    """Batched solver for pure-Fit cycles.
+@dataclass
+class ClassifiedCycle:
+    """Phase-1 output: fixed per-head assignments for one cycle."""
+    packed: PackedCycle
+    heads: list[Info]
+    snapshot: Snapshot
+    fit_slot0: np.ndarray        # [W] int32, -1 = no fit
+    borrows0: np.ndarray         # [W] bool
+    preempt0: np.ndarray         # [W] bool (no fit, preempt-capable)
+    preempt_slot0: np.ndarray    # [W] int32
+    preempt_borrows0: np.ndarray  # [W] bool
+    preempt_res_fit: np.ndarray  # [W, R] bool
 
-    backend="device" runs the jitted JAX kernel (TPU/CPU via XLA);
-    backend="native" runs the C++ core (kueue_tpu/native) — identical
-    decisions either way."""
+    @property
+    def n(self) -> int:
+        return self.packed.wl_count
+
+
+@dataclass
+class DeviceCycleFinal:
+    """Full-cycle device decisions, in cycle order."""
+    order: np.ndarray            # [n] head indices, cycle order
+    admitted: np.ndarray         # [n] bool (head order)
+    reserve_mask: np.ndarray     # [n] bool (head order)
+
+
+class CycleSolver:
+    """Batched solver for the admission cycle.
+
+    backend="auto" routes the admit scan to the accelerator when the
+    cycle is big enough to amortize the dispatch round-trip, else to the
+    XLA CPU backend; "cpu"/"accel" force a backend; "native" runs the
+    C++ phase-1 core (kueue_tpu/native) with the scan on CPU.  Identical
+    decisions on every backend."""
 
     def __init__(self, ordering: Ordering | None = None,
-                 backend: str = "device"):
+                 backend: str = "auto",
+                 accel_min_heads: int | None = None):
         self.ordering = ordering or Ordering()
+        if backend == "device":      # legacy alias
+            backend = "auto"
         self.backend = backend
-        self.stats = {"device_cycles": 0, "host_fallbacks": 0}
+        if accel_min_heads is None:
+            accel_min_heads = int(os.environ.get(
+                "KUEUE_TPU_ACCEL_MIN_HEADS", "512"))
+        self.accel_min_heads = accel_min_heads
+        self.stats = {
+            "device_cycles": 0,       # cycles with any device decisions
+            "full_cycles": 0,         # fully device-decided cycles
+            "classify_cycles": 0,     # device nominate + host admit loop
+            "host_fallbacks": 0,      # cycles needing any host assignment
+            "reserve_entries": 0,
+            "accel_dispatches": 0,
+            "cpu_dispatches": 0,
+            "structure_rebuilds": 0,
+        }
+        self._structure: Optional[PackedStructure] = None
+        self._potential0 = None
+        self._devices_resolved = False
+        self._cpu_dev = None
+        self._accel_dev = None
+
+    # -- device routing ------------------------------------------------
+
+    def _resolve_devices(self):
+        if self._devices_resolved:
+            return
+        import jax
+        try:
+            self._cpu_dev = jax.devices("cpu")[0]
+            default = jax.devices()[0]
+            self._accel_dev = default if default.platform != "cpu" else None
+        except RuntimeError:
+            # a registered accelerator plugin that can't initialize (e.g.
+            # no tunnel) must not take the CPU path down with it
+            try:
+                jax.config.update("jax_platforms", "cpu")
+            except Exception:
+                pass
+            self._cpu_dev = jax.devices("cpu")[0]
+            self._accel_dev = None
+        self._devices_resolved = True
+
+    def _pick_device(self, n_heads: int):
+        self._resolve_devices()
+        if self.backend in ("cpu", "native"):
+            return self._cpu_dev
+        if self.backend == "accel":
+            return self._accel_dev or self._cpu_dev
+        # auto: a tunneled-accelerator round trip is ~100 ms flat; only
+        # cycles with enough heads amortize it
+        if self._accel_dev is not None and n_heads >= self.accel_min_heads:
+            return self._accel_dev
+        return self._cpu_dev
+
+    def warmup(self, snapshot: Snapshot, max_heads: int) -> None:
+        """One-time setup outside the hot loop: resolve backends (a
+        tunneled TPU client can take tens of seconds to connect) and
+        compile the admit scan for every head-count bucket up to
+        ``max_heads``.  Shapes only — no scheduling state is touched."""
+        import jax
+        from .packing import _bucket
+        self._resolve_devices()
+        st = self._structure_for(snapshot, [])
+        N, F = st.subtree_quota.shape
+        C, S, R = st.slot_fr.shape
+        W = 8
+        buckets = []
+        while True:
+            buckets.append(W)
+            if W >= _bucket(max_heads):
+                break
+            W *= 2
+        for W in buckets:
+            args = (
+                np.zeros((N, F), np.int32), st.subtree_quota, st.guaranteed,
+                st.borrow_cap, st.has_borrow_limit, st.parent, st.slot_fr,
+                st.nominal_cq, st.nominal_plus_blimit_cq,
+                np.full(W, -1, np.int32), np.zeros((W, R), np.int32),
+                np.full(W, -1, np.int32), np.zeros(W, bool),
+                np.zeros(W, np.int32), np.zeros(W, bool),
+                np.arange(W, dtype=np.int32))
+            # head counts inside one bucket can route to different
+            # backends when accel_min_heads falls mid-bucket — warm every
+            # device the bucket can reach
+            devs = {self._pick_device(max(1, W // 2 + 1)),
+                    self._pick_device(W)}
+            for dev in devs:
+                with jax.default_device(dev):
+                    jax.block_until_ready(admit_scan(*args, depth=st.depth))
+
+    # -- structure cache -----------------------------------------------
+
+    def _structure_for(self, snapshot: Snapshot,
+                       heads: list[Info]) -> PackedStructure:
+        gen = getattr(snapshot, "structure_generation", -1)
+        st = self._structure
+        if st is None or st.generation != gen or gen < 0:
+            st = pack_structure(snapshot, heads, generation=gen)
+            st.static_eligible = self._static_eligible(snapshot)
+            self._structure = st
+            self._potential0 = None
+            self.stats["structure_rebuilds"] += 1
+        return st
 
     # -- eligibility ---------------------------------------------------
 
-    def _supported(self, snapshot: Snapshot, heads: list[Info]) -> bool:
+    def _static_eligible(self, snapshot: Snapshot) -> bool:
+        """Spec-level support checks, cached with the structure."""
+        for name, cq in snapshot.cluster_queues.items():
+            if len(cq.spec.resource_groups) > 1:
+                return False
+            ff = cq.spec.flavor_fungibility
+            if (ff.when_can_borrow != _DEFAULT_FF.when_can_borrow
+                    or ff.when_can_preempt != _DEFAULT_FF.when_can_preempt):
+                return False
+            for rg in cq.spec.resource_groups:
+                for fq in rg.flavors:
+                    flavor = snapshot.resource_flavors.get(fq.name)
+                    if flavor is None:
+                        return False
+                    if flavor.node_taints or flavor.topology_name:
+                        return False
+        return True
+
+    def _heads_eligible(self, snapshot: Snapshot, heads: list[Info]) -> bool:
         for h in heads:
             if len(h.obj.pod_sets) > 1:
                 # the host can split flavors across pod sets; the device
@@ -68,89 +231,228 @@ class CycleSolver:
                     return False
                 if ps.node_selector or ps.required_node_affinity or ps.tolerations:
                     return False  # affinity/taint matching stays on host
-        for name, cq in snapshot.cluster_queues.items():
-            if len(cq.spec.resource_groups) > 1:
-                return False
-            ff = cq.spec.flavor_fungibility
-            if (ff.when_can_borrow != _DEFAULT_FF.when_can_borrow
-                    or ff.when_can_preempt != _DEFAULT_FF.when_can_preempt):
-                return False
-            for rg in cq.spec.resource_groups:
-                for fq in rg.flavors:
-                    flavor = snapshot.resource_flavors.get(fq.name)
-                    if flavor is None:
-                        return False
-                    if flavor.node_taints or flavor.topology_name:
-                        return False
         return True
 
-    # -- solve ---------------------------------------------------------
+    # -- phase 1 -------------------------------------------------------
+
+    def classify(self, snapshot: Snapshot,
+                 heads: list[Info]) -> Optional[ClassifiedCycle]:
+        """Pack + vectorized nominate.  None → run the host path."""
+        if not heads:
+            return None
+        st = self._structure_for(snapshot, heads)
+        if not getattr(st, "static_eligible", False):
+            return None
+        if not self._heads_eligible(snapshot, heads):
+            return None
+        packed = pack_cycle(snapshot, heads, self.ordering, structure=st)
+        if packed is None:
+            # topology drifted under an unchanged generation (defensive):
+            # rebuild once and retry
+            self._structure = None
+            st = self._structure_for(snapshot, heads)
+            if not getattr(st, "static_eligible", False):
+                return None
+            packed = pack_cycle(snapshot, heads, self.ordering, structure=st)
+            if packed is None:
+                return None
+        if not packed.exact:
+            # lossy int32 scaling could deny fits the host grants
+            return None
+        if self._potential0 is None or self._potential0.shape != packed.usage0.shape:
+            from .cycle import available_all_np
+            self._potential0 = available_all_np(
+                np.zeros_like(packed.usage0), st.subtree_quota, st.guaranteed,
+                st.borrow_cap, st.has_borrow_limit, st.parent, st.depth)
+
+        if self.backend == "native":
+            from .. import native
+            fit_slot0, borrows0, preempt0 = native.classify_cycle(packed)
+            n = packed.wl_count
+            W = packed.wl_cq.shape[0]
+            R = len(st.resource_names)
+            out = {
+                "fit_slot0": np.asarray(fit_slot0),
+                "borrows0": np.asarray(borrows0),
+                "preempt0": np.asarray(preempt0),
+                "preempt_slot0": np.full(W, -1, np.int32),
+                "preempt_borrows0": np.zeros(W, bool),
+                "preempt_res_fit": np.ones((W, R), bool),
+            }
+            if out["preempt0"][:n].any():
+                # the C++ core covers fit/borrow/preempt-possible; the
+                # preempt-slot details come from the numpy pass on demand
+                det = classify_np(packed, potential0=self._potential0)
+                for k in ("preempt_slot0", "preempt_borrows0",
+                          "preempt_res_fit"):
+                    out[k] = det[k]
+        else:
+            out = classify_np(packed, potential0=self._potential0)
+        return ClassifiedCycle(
+            packed=packed, heads=heads, snapshot=snapshot,
+            fit_slot0=out["fit_slot0"], borrows0=out["borrows0"],
+            preempt0=out["preempt0"], preempt_slot0=out["preempt_slot0"],
+            preempt_borrows0=out["preempt_borrows0"],
+            preempt_res_fit=out["preempt_res_fit"])
+
+    # -- phase 2 -------------------------------------------------------
+
+    def solve_full(self, cls: ClassifiedCycle,
+                   reserve_mask: np.ndarray) -> DeviceCycleFinal:
+        """Dispatch the admit scan; every entry's decision is final.
+
+        ``reserve_mask`` (head order) marks preempt-classified entries the
+        scheduler verified have zero preemption candidates — they reserve
+        capacity in-scan (resourcesToReserve) and requeue."""
+        import jax
+        packed = cls.packed
+        st = packed.structure
+        W = packed.wl_cq.shape[0]
+        rmask = np.zeros(W, dtype=bool)
+        rmask[:len(reserve_mask)] = reserve_mask
+        borrows = cls.borrows0 | (cls.preempt_borrows0 & rmask)
+        order = cycle_order_np(borrows, packed.wl_priority,
+                               packed.wl_timestamp)
+        dev = self._pick_device(cls.n)
+        if dev is self._accel_dev and self._accel_dev is not None:
+            self.stats["accel_dispatches"] += 1
+        else:
+            self.stats["cpu_dispatches"] += 1
+        with jax.default_device(dev):
+            admitted = admit_scan(
+                packed.usage0, st.subtree_quota, st.guaranteed,
+                st.borrow_cap, st.has_borrow_limit, st.parent, st.slot_fr,
+                st.nominal_cq, st.nominal_plus_blimit_cq, packed.wl_cq,
+                packed.wl_requests, cls.fit_slot0, rmask,
+                np.maximum(cls.preempt_slot0, 0),
+                cls.preempt_borrows0 & rmask, order, depth=st.depth)
+            admitted = np.asarray(jax.device_get(admitted))
+        n = cls.n
+        self.stats["reserve_entries"] += int(rmask[:n].sum())
+        return DeviceCycleFinal(
+            order=order[order < n],
+            admitted=admitted[:n], reserve_mask=rmask[:n])
+
+    # -- assignment reconstruction -------------------------------------
+
+    def build_fit_assignment(self, cls: ClassifiedCycle, wi: int) -> Assignment:
+        """Host Assignment for a device-classified Fit head, including the
+        fungibility resume state the host walk would record."""
+        slot = int(cls.fit_slot0[wi])
+        borrow = bool(cls.borrows0[wi])
+        return self._build_assignment(cls, wi, slot, Mode.FIT, borrow)
+
+    def _build_assignment(self, cls: ClassifiedCycle, wi: int, slot: int,
+                          mode: Mode, borrow: bool) -> Assignment:
+        h = cls.heads[wi]
+        snapshot = cls.snapshot
+        cq = snapshot.cq(h.cluster_queue)
+        rg = cq.spec.resource_groups[0]
+        covers_pods = "pods" in rg.covered_resources
+        flavor_name = rg.flavors[slot].name
+        n_slots = len(rg.flavors)
+        tried = -1 if slot == n_slots - 1 else slot
+
+        assignment = Assignment()
+        assignment.borrowing = borrow
+        assignment.last_state = AssignmentClusterQueueState(
+            cluster_queue_generation=cq.allocatable_generation)
+        for psr in h.total_requests:
+            # mirror the host's implicit "pods" handling
+            # (flavorassigner.go:226 / _assign_flavors)
+            reqs = dict(psr.requests)
+            if covers_pods:
+                reqs["pods"] = psr.count
+            else:
+                reqs.pop("pods", None)
+            ps_res = PodSetAssignmentResult(
+                name=psr.name, requests=Requests(reqs), count=psr.count)
+            flavor_idx: dict[str, int] = {}
+            for res in reqs:
+                ps_res.flavors[res] = FlavorAssignmentDecision(
+                    name=flavor_name, mode=mode, borrow=borrow,
+                    tried_flavor_idx=tried)
+                flavor_idx[res] = tried
+                fr = FlavorResource(flavor_name, res)
+                assignment.usage[fr] = (assignment.usage.get(fr, 0)
+                                        + reqs[res])
+            assignment.pod_sets.append(ps_res)
+            assignment.last_state.last_tried_flavor_idx.append(flavor_idx)
+        return assignment
+
+    def reserve_details(self, cls: ClassifiedCycle, wi: int
+                        ) -> tuple[Assignment, str]:
+        """Assignment + inadmissible message for a preempt-classified head
+        with no candidates (single-flavor CQs only), replicating the host
+        walk's reasons (flavorassigner.go:692 messages)."""
+        h = cls.heads[wi]
+        slot = int(cls.preempt_slot0[wi])
+        borrow = bool(cls.preempt_borrows0[wi])
+        assignment = self._build_assignment(cls, wi, slot, Mode.PREEMPT,
+                                            borrow)
+        cq = cls.snapshot.cq(h.cluster_queue)
+        ps = assignment.pod_sets[0]
+        reasons = []
+        for res in sorted(ps.requests):
+            val = ps.requests[res]
+            fr = FlavorResource(ps.flavors[res].name, res)
+            avail = cq.available(fr)
+            if val > avail:
+                reasons.append(
+                    f"insufficient unused quota for {res} in flavor "
+                    f"{fr.flavor}, {val - avail} more needed")
+        ps.reasons = reasons
+        return assignment, assignment.message()
+
+    def preemption_probe(self, cls: ClassifiedCycle, wi: int
+                         ) -> tuple[set, FlavorResourceQuantities]:
+        """(frs_need_preemption, workload_usage) for a preempt head —
+        the inputs candidate discovery needs (preemption.go:466,480)."""
+        h = cls.heads[wi]
+        st = cls.packed.structure
+        cq = cls.snapshot.cq(h.cluster_queue)
+        rg = cq.spec.resource_groups[0]
+        flavor_name = rg.flavors[int(cls.preempt_slot0[wi])].name
+        covers_pods = "pods" in rg.covered_resources
+        res_fit = cls.preempt_res_fit[wi]
+        usage = FlavorResourceQuantities()
+        frs_need = set()
+        for psr in h.total_requests:
+            reqs = dict(psr.requests)
+            if covers_pods:
+                reqs["pods"] = psr.count
+            else:
+                reqs.pop("pods", None)
+            for res, val in reqs.items():
+                fr = FlavorResource(flavor_name, res)
+                usage[fr] = usage.get(fr, 0) + val
+                ri = st.r_index.get(res)
+                if ri is not None and not res_fit[ri]:
+                    frs_need.add(fr)
+        return frs_need, usage
+
+    def slot_count(self, cls: ClassifiedCycle, wi: int) -> int:
+        st = cls.packed.structure
+        ci = st.cq_index.get(cls.heads[wi].cluster_queue, -1)
+        return int(st.slot_count_cq[ci]) if ci >= 0 else 0
+
+    # -- back-compat one-shot API (tests/probes) -----------------------
 
     def try_solve(self, snapshot: Snapshot, heads: list[Info]
                   ) -> Optional[dict[str, Assignment]]:
-        """Returns {workload_key: Fit Assignment} for admitted heads, or
-        None when the host path must run."""
-        if not heads or not self._supported(snapshot, heads):
+        """Classify-only: {workload_key: Fit Assignment} for heads that fit
+        at snapshot usage, or None when the host path must run (any
+        preempt-capable head, or unsupported semantics)."""
+        cls = self.classify(snapshot, heads)
+        if cls is None:
             self.stats["host_fallbacks"] += 1
             return None
-        packed = pack_cycle(snapshot, heads, self.ordering)
-        if not packed.exact:
-            # lossy int32 scaling could deny fits the host grants
-            self.stats["host_fallbacks"] += 1
-            return None
-        if self.backend == "native":
-            from .. import native
-            fit_slot0, borrows0, preempt_possible = native.classify_cycle(
-                packed)
-        else:
-            (_admitted, _slots, _borrows, preempt_possible,
-             fit_slot0, borrows0) = solve_cycle(
-                packed.usage0, packed.subtree_quota, packed.guaranteed,
-                packed.borrow_cap, packed.has_borrow_limit, packed.parent,
-                packed.nominal_cq, packed.slot_fr, packed.slot_valid,
-                packed.cq_can_preempt_borrow,
-                packed.wl_cq, packed.wl_requests, packed.wl_priority,
-                packed.wl_timestamp, depth=packed.depth, run_scan=False)
-            fit_slot0 = np.asarray(fit_slot0)
-            borrows0 = np.asarray(borrows0)
-            preempt_possible = np.asarray(preempt_possible)
-        n = packed.wl_count
-        if preempt_possible[:n].any():
-            # preemption semantics stay on host for now
+        if cls.preempt0[:cls.n].any():
             self.stats["host_fallbacks"] += 1
             return None
         self.stats["device_cycles"] += 1
-
         out: dict[str, Assignment] = {}
-        for wi in range(n):
-            if fit_slot0[wi] < 0:
-                continue
-            h = heads[wi]
-            cq = snapshot.cq(h.cluster_queue)
-            rg = cq.spec.resource_groups[0]
-            covers_pods = "pods" in rg.covered_resources
-            flavor_name = rg.flavors[int(fit_slot0[wi])].name
-            assignment = Assignment()
-            assignment.borrowing = bool(borrows0[wi])
-            assignment.last_state.cluster_queue_generation = cq.allocatable_generation
-            for psr in h.total_requests:
-                # mirror the host's implicit "pods" handling
-                # (flavorassigner.go:226 / _assign_flavors)
-                reqs = dict(psr.requests)
-                if covers_pods:
-                    reqs["pods"] = psr.count
-                else:
-                    reqs.pop("pods", None)
-                ps_res = PodSetAssignmentResult(
-                    name=psr.name, requests=Requests(reqs),
-                    count=psr.count)
-                for res in reqs:
-                    ps_res.flavors[res] = FlavorAssignmentDecision(
-                        name=flavor_name, mode=Mode.FIT,
-                        borrow=bool(borrows0[wi]))
-                    fr = FlavorResource(flavor_name, res)
-                    assignment.usage[fr] = (assignment.usage.get(fr, 0)
-                                            + reqs[res])
-                assignment.pod_sets.append(ps_res)
-            out[h.key] = assignment
+        for wi in range(cls.n):
+            if cls.fit_slot0[wi] >= 0:
+                out[cls.heads[wi].key] = self.build_fit_assignment(cls, wi)
         return out

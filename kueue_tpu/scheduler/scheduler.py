@@ -129,7 +129,18 @@ class Scheduler:
             return stats
         snapshot = self.cache.snapshot()
         entries = self.nominate(heads, snapshot)
-        self._maybe_solve_on_device(entries, snapshot)
+        device_final = self._maybe_solve_on_device(entries, snapshot)
+        if device_final is not None:
+            self._admit_device_cycle(device_final, snapshot, stats)
+            for e in entries:
+                if e.status != EntryStatus.ASSUMED:
+                    self._requeue_and_update(e)
+                    if e.status == EntryStatus.SKIPPED:
+                        stats.skipped.append(e.info.key)
+                    else:
+                        stats.inadmissible.append(e.info.key)
+            stats.duration_s = self.clock() - start
+            return stats
         iterator = self._make_iterator(entries, snapshot)
 
         preempted_workloads: dict[str, Info] = {}
@@ -231,29 +242,109 @@ class Scheduler:
         e.info.last_assignment = e.assignment.last_state
 
     def _maybe_solve_on_device(self, entries: list[Entry],
-                               snapshot: Snapshot) -> None:
-        """Batched nominate: one device solve replaces per-head flavor
-        assignment when the cycle needs no preemption/TAS semantics."""
+                               snapshot: Snapshot):
+        """Batched nominate + (when possible) a fully device-decided cycle.
+
+        Two device modes (kueue_tpu.ops.solver):
+        - FULL: no preempt-classified head has preemption candidates — the
+          admit scan runs as one jitted program and every decision is
+          final; returns (deferred, cls, final) for _admit_device_cycle.
+        - CLASSIFY: some head needs a real preemption search — the device
+          classification replaces per-head flavor assignment for Fit heads
+          and the host admit loop runs; returns None.
+        """
+        import numpy as np
         deferred = [e for e in entries if e.inadmissible_msg == "__deferred__"]
         if not deferred:
-            return
-        solved = None
-        if self.solver is not None:
-            solved = self.solver.try_solve(snapshot, [e.info for e in deferred])
-        if solved is None:
+            return None
+        solver = self.solver
+        cls = (solver.classify(snapshot, [e.info for e in deferred])
+               if solver is not None else None)
+        if cls is None:
+            if solver is not None:
+                solver.stats["host_fallbacks"] += 1
             for e in deferred:
-                self._assign_entry(e, snapshot)
-            return
-        for e in deferred:
-            assignment = solved.get(e.info.key)
-            if assignment is not None:
-                e.assignment = assignment
                 e.inadmissible_msg = ""
+                self._assign_entry(e, snapshot)
+            return None
+        n = cls.n
+        reserve = np.zeros(n, dtype=bool)
+        full_ok = True
+        for wi in np.nonzero(cls.preempt0[:n])[0]:
+            # Single-flavor CQs only: with several flavors the preempt
+            # best-slot choice depends on the reclaim oracle
+            # (flavorassigner.go:692 RECLAIM beats PREEMPT).
+            if solver.slot_count(cls, int(wi)) != 1:
+                full_ok = False
+                break
+            frs_need, usage = solver.preemption_probe(cls, int(wi))
+            e = deferred[wi]
+            from .preemption import _PreemptionCtx
+            ctx = _PreemptionCtx(
+                preemptor=e.info,
+                preemptor_cq=snapshot.cq(e.info.cluster_queue),
+                snapshot=snapshot,
+                frs_need_preemption=frs_need,
+                workload_usage=usage)
+            if self.preemptor._find_candidates(ctx):
+                full_ok = False
+                break
+            reserve[wi] = True
+
+        if not full_ok:
+            solver.stats["classify_cycles"] += 1
+            solver.stats["device_cycles"] += 1
+            solver.stats["host_fallbacks"] += 1
+            for wi, e in enumerate(deferred):
+                e.inadmissible_msg = ""
+                if cls.fit_slot0[wi] >= 0:
+                    e.assignment = solver.build_fit_assignment(cls, wi)
+                    e.info.last_assignment = e.assignment.last_state
+                else:
+                    # preempt/nofit heads need the host walk (targets,
+                    # exact reasons, resume state)
+                    self._assign_entry(e, snapshot)
+            return None
+
+        final = solver.solve_full(cls, reserve)
+        solver.stats["full_cycles"] += 1
+        solver.stats["device_cycles"] += 1
+        return (deferred, cls, final)
+
+    def _admit_device_cycle(self, device, snapshot: Snapshot,
+                            stats: CycleStats) -> None:
+        """Apply a fully device-decided cycle: admit in cycle order, mark
+        in-scan losers skipped, reserve-and-requeue candidate-less preempt
+        heads (decision-identical to the host admit loop)."""
+        deferred, cls, final = device
+        solver = self.solver
+        for wi in final.order:
+            wi = int(wi)
+            e = deferred[wi]
+            cq = snapshot.cq(e.info.cluster_queue)
+            if final.admitted[wi]:
+                e.assignment = solver.build_fit_assignment(cls, wi)
+                e.info.last_assignment = e.assignment.last_state
+                e.inadmissible_msg = ""
+                e.status = EntryStatus.NOMINATED
+                if self._admit(e, cq):
+                    stats.admitted.append(e.info.key)
+                else:
+                    e.inadmissible_msg = "Failed to admit workload"
+            elif cls.fit_slot0[wi] >= 0:
+                # fit at nominate, lost capacity in-scan (scheduler.go:245)
+                e.assignment = solver.build_fit_assignment(cls, wi)
+                e.info.last_assignment = e.assignment.last_state
+                self._set_skipped(e, "Workload no longer fits after "
+                                     "processing another workload")
+            elif final.reserve_mask[wi]:
+                e.assignment, e.inadmissible_msg = solver.reserve_details(
+                    cls, wi)
                 e.info.last_assignment = e.assignment.last_state
             else:
-                # the device only proves Fit; recompute non-fitting entries
-                # on the host for exact inadmissible reasons and
-                # fungibility resume state
+                # NoFit: the host walk produces the exact reasons and
+                # resume state
+                e.inadmissible_msg = ""
                 self._assign_entry(e, snapshot)
 
     @staticmethod

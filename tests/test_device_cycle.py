@@ -1,0 +1,192 @@
+"""Per-cycle decision parity: the fully device-decided cycle (classify_np
++ admit_scan with capacity reserves) must match the host admit loop
+cycle-for-cycle — admissions (and their order), skips, inadmissible sets,
+and assigned flavors — across multi-cycle runs with finishes, borrowing
+races, and preempt-classified heads."""
+
+import random
+
+import pytest
+
+from kueue_tpu.api.types import (
+    ClusterQueue,
+    FlavorQuotas,
+    LocalQueue,
+    PodSet,
+    PreemptionPolicy,
+    ReclaimWithinCohort,
+    ResourceFlavor,
+    ResourceGroup,
+    ResourceQuota,
+    WithinClusterQueue,
+    Workload,
+)
+from kueue_tpu.controller.driver import Driver
+
+
+class FakeClock:
+    def __init__(self, now=1000.0):
+        self.t = now
+
+    def __call__(self):
+        return self.t
+
+
+def build_driver(seed, use_device, n_cohorts=2, cqs_per_cohort=3, n_wl=60,
+                 preemption=True):
+    rng = random.Random(seed)
+    clock = FakeClock()
+    d = Driver(clock=clock, use_device_solver=use_device,
+               solver_backend="cpu" if use_device else "auto")
+    d.apply_resource_flavor(ResourceFlavor(name="default"))
+    pre = (PreemptionPolicy(
+        reclaim_within_cohort=ReclaimWithinCohort.ANY,
+        within_cluster_queue=WithinClusterQueue.LOWER_PRIORITY)
+        if preemption else PreemptionPolicy())
+    for c in range(n_cohorts):
+        for q in range(cqs_per_cohort):
+            name = f"cq-{c}-{q}"
+            d.apply_cluster_queue(ClusterQueue(
+                name=name, cohort=f"cohort-{c}", preemption=pre,
+                resource_groups=[ResourceGroup(
+                    covered_resources=["cpu"],
+                    flavors=[FlavorQuotas(name="default", resources={
+                        "cpu": ResourceQuota(nominal=4000,
+                                             borrowing_limit=8000)})])]))
+            d.apply_local_queue(LocalQueue(name=f"lq-{c}-{q}",
+                                           cluster_queue=name))
+    workloads = []
+    for i in range(n_wl):
+        c = rng.randrange(n_cohorts)
+        q = rng.randrange(cqs_per_cohort)
+        workloads.append(Workload(
+            name=f"wl-{i}", queue_name=f"lq-{c}-{q}",
+            priority=rng.choice([10, 10, 50, 100]),
+            creation_time=float(i + 1),
+            pod_sets=[PodSet(name="main", count=1,
+                             requests={"cpu": rng.choice(
+                                 [1000, 2000, 4000])})]))
+    return d, clock, workloads
+
+
+def drive_cycles(d, clock, workloads, n_cycles=40, runtime=2):
+    """Create all workloads, run cycles with fake execution; record each
+    cycle's decisions."""
+    for wl in workloads:
+        d.create_workload(wl)
+    log = []
+    running = []
+    for cycle in range(n_cycles):
+        clock.t += 1.0
+        stats = d.schedule_once()
+        admissions = []
+        for key in stats.admitted:
+            wl = d.workload(key)
+            flavors = tuple(sorted(
+                (a.name, a.count, tuple(sorted(a.flavors.items())))
+                for a in wl.admission.pod_set_assignments))
+            admissions.append((key, flavors))
+            running.append((cycle + runtime, key))
+        log.append({
+            "admitted": admissions,
+            "skipped": sorted(stats.skipped),
+            "inadmissible": sorted(stats.inadmissible),
+            "preempting": sorted(stats.preempting),
+            "targets": sorted(stats.preempted_targets),
+        })
+        still = []
+        for fin, key in running:
+            wl = d.workload(key)
+            if wl is None or not wl.has_quota_reservation:
+                continue
+            if fin <= cycle:
+                d.finish_workload(key)
+            else:
+                still.append((fin, key))
+        running = still
+    return log
+
+
+@pytest.mark.parametrize("seed", [11, 12, 13, 14])
+def test_per_cycle_parity_host_vs_device(seed):
+    host, hclock, hwl = build_driver(seed, use_device=False)
+    dev, dclock, dwl = build_driver(seed, use_device=True)
+    hlog = drive_cycles(host, hclock, hwl)
+    dlog = drive_cycles(dev, dclock, dwl)
+    for cyc, (h, dv) in enumerate(zip(hlog, dlog)):
+        assert h == dv, (
+            f"seed {seed} cycle {cyc} diverged:\nhost={h}\ndevice={dv}\n"
+            f"stats={dev.scheduler.solver.stats}")
+    stats = dev.scheduler.solver.stats
+    assert stats["full_cycles"] >= 1, stats
+    assert stats["device_cycles"] >= 1, stats
+
+
+def test_reserve_path_runs_on_device():
+    """Equal-priority contention: the pending head classifies
+    preempt-capable with zero candidates → the device cycle reserves
+    capacity and stays fully device-decided (no host fallback)."""
+    clock = FakeClock()
+    d = Driver(clock=clock, use_device_solver=True, solver_backend="cpu")
+    d.apply_resource_flavor(ResourceFlavor(name="default"))
+    d.apply_cluster_queue(ClusterQueue(
+        name="cq",
+        preemption=PreemptionPolicy(
+            within_cluster_queue=WithinClusterQueue.LOWER_PRIORITY),
+        resource_groups=[ResourceGroup(covered_resources=["cpu"], flavors=[
+            FlavorQuotas(name="default",
+                         resources={"cpu": ResourceQuota(nominal=2000)})])]))
+    d.apply_local_queue(LocalQueue(name="lq", cluster_queue="cq"))
+    d.create_workload(Workload(name="a", queue_name="lq", priority=50,
+                               creation_time=1.0,
+                               pod_sets=[PodSet(name="main", count=1,
+                                                requests={"cpu": 2000})]))
+    d.create_workload(Workload(name="b", queue_name="lq", priority=50,
+                               creation_time=2.0,
+                               pod_sets=[PodSet(name="main", count=1,
+                                                requests={"cpu": 2000})]))
+    d.schedule_once()   # admits a
+    d.schedule_once()   # b: preempt-capable, equal priority → no candidates
+    stats = d.scheduler.solver.stats
+    assert stats["reserve_entries"] >= 1, stats
+    assert stats["full_cycles"] >= 2, stats
+    assert d.admitted_keys() == {"default/a"}
+    # b parked with the host-identical insufficient-quota message
+    b = d.workload("default/b")
+    assert b is not None and not b.has_quota_reservation
+
+
+def test_skip_race_matches_host():
+    """Two borrowing heads race for the same cohort headroom: the first
+    admits, the second must be SKIPPED (scheduler.go:245) — identically on
+    both paths."""
+    logs = []
+    for use_device in (False, True):
+        clock = FakeClock()
+        d = Driver(clock=clock, use_device_solver=use_device,
+                   solver_backend="cpu" if use_device else "auto")
+        d.apply_resource_flavor(ResourceFlavor(name="default"))
+        for i in range(2):
+            d.apply_cluster_queue(ClusterQueue(
+                name=f"cq-{i}", cohort="team",
+                resource_groups=[ResourceGroup(
+                    covered_resources=["cpu"],
+                    flavors=[FlavorQuotas(name="default", resources={
+                        "cpu": ResourceQuota(nominal=1000,
+                                             borrowing_limit=2000)})])]))
+            d.apply_local_queue(LocalQueue(name=f"lq-{i}",
+                                           cluster_queue=f"cq-{i}"))
+        # each wants 2000: fits only by borrowing the cohort's slack (the
+        # other CQ's unused 1000); the first admission consumes it
+        for i in range(2):
+            d.create_workload(Workload(
+                name=f"w{i}", queue_name=f"lq-{i}",
+                creation_time=float(i + 1),
+                pod_sets=[PodSet(name="main", count=1,
+                                 requests={"cpu": 2000})]))
+        stats = d.schedule_once()
+        logs.append((list(stats.admitted), sorted(stats.skipped),
+                     sorted(stats.inadmissible)))
+    assert logs[0] == logs[1], logs
+    admitted, skipped, _ = logs[1]
+    assert len(admitted) == 1 and len(skipped) == 1, logs
