@@ -64,7 +64,7 @@ class VirtualClock:
 def build(scale: float):
     clock = VirtualClock()
     d = Driver(clock=clock,
-               use_device_solver=os.environ.get("BENCH_DEVICE") == "1")
+               use_device_solver=os.environ.get("BENCH_DEVICE", "1") == "1")
     d.apply_resource_flavor(ResourceFlavor(name="default"))
     total = 0
     for c in range(N_COHORTS):
@@ -146,9 +146,14 @@ def main():
     p50 = cycle_times[len(cycle_times) // 2] if cycle_times else 0.0
     p99 = cycle_times[int(len(cycle_times) * 0.99)] if cycle_times else 0.0
     aps = finished / wall if wall > 0 else 0.0
+    solver_stats = getattr(d.scheduler.solver, "stats", {})
+    # full + host_fallbacks = all cycles with heads (classify-mode cycles
+    # count in host_fallbacks: the host admit loop still ran)
+    full = solver_stats.get("full_cycles", 0)
+    share = 100.0 * full / max(1, full + solver_stats.get("host_fallbacks", 0))
     print(f"drained {finished}/{total} in {wall:.2f}s over {cycles} cycles; "
           f"cycle p50={p50 * 1e3:.2f}ms p99={p99 * 1e3:.2f}ms; "
-          f"device cycles={getattr(d.scheduler.solver, 'stats', {})}",
+          f"device-cycle share={share:.1f}% stats={solver_stats}",
           file=sys.stderr)
     print(json.dumps({
         "metric": "admissions_per_sec_drain_15k_workloads_30cq",

@@ -438,7 +438,13 @@ class Workload:
 
     @property
     def key(self) -> str:
-        return f"{self.namespace}/{self.name}"
+        # memoized: hot identity in cache/queue maps (name is immutable
+        # after creation, webhook validation enforces it)
+        k = self.__dict__.get("_key")
+        if k is None:
+            k = f"{self.namespace}/{self.name}"
+            self.__dict__["_key"] = k
+        return k
 
     # -- condition helpers (reference pkg/workload/workload.go:774-789) --
     def condition_true(self, cond_type: str) -> bool:

@@ -51,6 +51,9 @@ class Cache:
         # Bumped on any spec-level change (CQ/cohort/flavor/check); the
         # solver caches its packed structure tensors against this.
         self.structure_generation = 0
+        # workload key → owning CQ name (O(1) duplicate/ownership lookups;
+        # the reference keys cache membership the same way, cache.go:536)
+        self._wl_owner: dict[str, str] = {}
 
     # ------------------------------------------------------------------
     # ClusterQueues / Cohorts
@@ -68,6 +71,10 @@ class Cache:
 
     def delete_cluster_queue(self, name: str) -> None:
         with self._lock:
+            cq = self._mgr.cluster_queues.get(name)
+            if cq is not None:
+                for key in cq.workloads:
+                    self._wl_owner.pop(key, None)
             self._mgr.delete_cluster_queue(name)
             self._rebuild()
 
@@ -152,6 +159,7 @@ class Cache:
                 return False
             info.cluster_queue = cq.name
             cq.add_workload(info)
+            self._wl_owner[info.key] = cq.name
             self.assumed_workloads.discard(info.key)
             return True
 
@@ -160,6 +168,7 @@ class Cache:
             cq = self._find_owner(info)
             if cq is not None:
                 cq.remove_workload(cq.workloads[info.key])
+                self._wl_owner.pop(info.key, None)
             self.assumed_workloads.discard(info.key)
 
     def assume_workload(self, info: Info) -> bool:
@@ -175,6 +184,7 @@ class Cache:
                 return False
             info.cluster_queue = cq.name
             cq.add_workload(info)
+            self._wl_owner[info.key] = cq.name
             self.assumed_workloads.add(info.key)
             return True
 
@@ -186,16 +196,15 @@ class Cache:
             cq = self._find_owner(info)
             if cq is not None:
                 cq.remove_workload(cq.workloads[info.key])
+                self._wl_owner.pop(info.key, None)
             self.assumed_workloads.discard(info.key)
             return True
 
     def _find_owner(self, info: Info) -> Optional[CQState]:
-        if info.cluster_queue:
-            cq = self._mgr.cluster_queues.get(info.cluster_queue)
+        owner = self._wl_owner.get(info.key)
+        if owner is not None:
+            cq = self._mgr.cluster_queues.get(owner)
             if cq is not None and info.key in cq.workloads:
-                return cq
-        for cq in self._mgr.cluster_queues.values():
-            if info.key in cq.workloads:
                 return cq
         return None
 

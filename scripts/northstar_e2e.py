@@ -1,0 +1,164 @@
+"""North-star END-TO-END run: the real Driver at 100k pending workloads
+across 1k ClusterQueues, device solver on — pack + classify + admit-scan +
+unpack + store updates per cycle, nothing synthetic.
+
+Role-matches the reference's integrated perf artifact
+(/root/reference/test/performance/scheduler/minimalkueue/main.go): the
+whole scheduling path is exercised, only job execution is faked (admitted
+workloads finish a fixed number of cycles after admission).
+
+Usage:
+    python scripts/northstar_e2e.py [--cqs 1000] [--wl 100000]
+        [--cycles 30] [--host]   (--host = scalar path for comparison)
+
+Prints per-cycle latency percentiles and a one-line JSON tail.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from kueue_tpu.api.types import (
+    ClusterQueue,
+    FlavorQuotas,
+    LocalQueue,
+    PodSet,
+    PreemptionPolicy,
+    ReclaimWithinCohort,
+    ResourceFlavor,
+    ResourceGroup,
+    ResourceQuota,
+    WithinClusterQueue,
+    Workload,
+)
+from kueue_tpu.controller.driver import Driver
+
+
+class VirtualClock:
+    def __init__(self, t=1000.0):
+        self.t = t
+
+    def __call__(self):
+        return self.t
+
+
+def build(n_cqs: int, n_wl: int, use_device: bool, cqs_per_cohort: int = 5,
+          n_flavors: int = 1, n_resources: int = 1):
+    clock = VirtualClock()
+    d = Driver(clock=clock, use_device_solver=use_device)
+    flavors = ([f"flavor-{f}" for f in range(n_flavors)]
+               if n_flavors > 1 else ["default"])
+    for f in flavors:
+        d.apply_resource_flavor(ResourceFlavor(name=f))
+    resources = (["cpu"] + [f"res-{r}" for r in range(1, n_resources)]
+                 if n_resources > 1 else ["cpu"])
+    per_cq = max(1, n_wl // n_cqs)
+    t_build = time.perf_counter()
+    for i in range(n_cqs):
+        cohort = f"cohort-{i // cqs_per_cohort}"
+        # early flavors are deliberately tight so the host flavor walk
+        # (flavorassigner.go:499) has to visit most of the list
+        d.apply_cluster_queue(ClusterQueue(
+            name=f"cq-{i}", cohort=cohort,
+            preemption=PreemptionPolicy(
+                reclaim_within_cohort=ReclaimWithinCohort.ANY,
+                within_cluster_queue=WithinClusterQueue.LOWER_PRIORITY),
+            resource_groups=[ResourceGroup(
+                covered_resources=list(resources),
+                flavors=[FlavorQuotas(name=f, resources={
+                    r: ResourceQuota(
+                        nominal=(500 if fi < len(flavors) - 1 else 20_000),
+                        borrowing_limit=100_000)
+                    for r in resources})
+                    for fi, f in enumerate(flavors)])]))
+        d.apply_local_queue(LocalQueue(name=f"lq-{i}",
+                                       cluster_queue=f"cq-{i}"))
+    total = 0
+    for i in range(n_cqs):
+        for k in range(per_cq):
+            total += 1
+            cls = k % 3
+            d.create_workload(Workload(
+                name=f"wl-{i}-{k}", queue_name=f"lq-{i}",
+                priority=(50, 100, 200)[cls],
+                creation_time=float(total),
+                pod_sets=[PodSet(name="main", count=1,
+                                 requests={r: (1000, 5000, 20000)[cls]
+                                           for r in resources})]))
+    print(f"built {n_cqs} CQs x {len(flavors)} flavors x "
+          f"{len(resources)} resources / {total} workloads in "
+          f"{time.perf_counter() - t_build:.1f}s", file=sys.stderr)
+    return d, clock, total
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--cqs", type=int, default=1000)
+    ap.add_argument("--wl", type=int, default=100_000)
+    ap.add_argument("--cycles", type=int, default=30)
+    ap.add_argument("--host", action="store_true")
+    ap.add_argument("--runtime", type=int, default=2)
+    ap.add_argument("--flavors", type=int, default=1)
+    ap.add_argument("--resources", type=int, default=1)
+    args = ap.parse_args()
+
+    d, clock, total = build(args.cqs, args.wl, use_device=not args.host,
+                            n_flavors=args.flavors,
+                            n_resources=args.resources)
+    if d.scheduler.solver is not None:
+        t_w = time.perf_counter()
+        d.scheduler.solver.warmup(d.cache.snapshot(), args.cqs)
+        print(f"solver warmup {time.perf_counter() - t_w:.1f}s",
+              file=sys.stderr)
+
+    cycle_times = []
+    admitted_total = 0
+    running = []
+    for cycle in range(args.cycles):
+        clock.t += 1.0
+        c0 = time.perf_counter()
+        stats = d.schedule_once()
+        dt = time.perf_counter() - c0
+        cycle_times.append(dt)
+        admitted_total += len(stats.admitted)
+        for key in stats.admitted:
+            running.append((cycle + args.runtime, key))
+        still = []
+        for fin, key in running:
+            wl = d.workloads.get(key)
+            if wl is None or not wl.has_quota_reservation:
+                continue
+            if fin <= cycle:
+                d.finish_workload(key)
+            else:
+                still.append((fin, key))
+        running = still
+        print(f"cycle {cycle}: {dt*1e3:.1f}ms admitted={len(stats.admitted)} "
+              f"preempting={len(stats.preempting)}", file=sys.stderr)
+
+    cycle_times.sort()
+    p50 = cycle_times[len(cycle_times) // 2]
+    p99 = cycle_times[min(len(cycle_times) - 1,
+                          int(len(cycle_times) * 0.99))]
+    solver = d.scheduler.solver
+    print(f"stats: {getattr(solver, 'stats', {})}", file=sys.stderr)
+    print(json.dumps({
+        "metric": "northstar_e2e_cycle_p99",
+        "value": round(p99 * 1e3, 1),
+        "unit": "ms",
+        "cqs": args.cqs, "workloads": total,
+        "p50_ms": round(p50 * 1e3, 1),
+        "admitted": admitted_total,
+        "flavors": args.flavors, "resources": args.resources,
+        "path": "host" if args.host else "device",
+    }))
+
+
+if __name__ == "__main__":
+    main()

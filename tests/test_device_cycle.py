@@ -156,6 +156,68 @@ def test_reserve_path_runs_on_device():
     assert b is not None and not b.has_quota_reservation
 
 
+def test_drain_scenario_device_share_gate():
+    """Regression gate for VERDICT weak item 5: on the bench drain
+    scenario shape every cycle must stay fully device-decided (no silent
+    eligibility shrink).  If a change makes the solver fall back, this
+    fails before the bench regresses."""
+    clock = FakeClock()
+    d = Driver(clock=clock, use_device_solver=True, solver_backend="cpu")
+    d.apply_resource_flavor(ResourceFlavor(name="default"))
+    for c in range(2):
+        for q in range(3):
+            name = f"cq-{c}-{q}"
+            d.apply_cluster_queue(ClusterQueue(
+                name=name, cohort=f"cohort-{c}",
+                preemption=PreemptionPolicy(
+                    reclaim_within_cohort=ReclaimWithinCohort.ANY,
+                    within_cluster_queue=WithinClusterQueue.LOWER_PRIORITY),
+                resource_groups=[ResourceGroup(
+                    covered_resources=["cpu"],
+                    flavors=[FlavorQuotas(name="default", resources={
+                        "cpu": ResourceQuota(nominal=20_000,
+                                             borrowing_limit=100_000)})])]))
+            d.apply_local_queue(LocalQueue(name=f"lq-{c}-{q}",
+                                           cluster_queue=name))
+            i = 0
+            for cls, count, units, prio in (("small", 10, 1, 50),
+                                            ("medium", 4, 5, 100),
+                                            ("large", 2, 20, 200)):
+                for k in range(count):
+                    i += 1
+                    d.create_workload(Workload(
+                        name=f"{cls}-{c}-{q}-{k}", queue_name=f"lq-{c}-{q}",
+                        priority=prio, creation_time=float(i),
+                        pod_sets=[PodSet(name="main", count=1,
+                                         requests={"cpu": units * 1000})]))
+    running = []
+    finished = 0
+    total = 96
+    for cycle in range(400):
+        if finished >= total:
+            break
+        clock.t += 1.0
+        stats = d.schedule_once()
+        for key in stats.admitted:
+            running.append((cycle + 2, key))
+        still = []
+        for fin, key in running:
+            wl = d.workload(key)
+            if wl is None or not wl.has_quota_reservation:
+                continue
+            if fin <= cycle:
+                d.finish_workload(key)
+                finished += 1
+            else:
+                still.append((fin, key))
+        running = still
+    assert finished == total
+    s = d.scheduler.solver.stats
+    assert s["host_fallbacks"] == 0, (
+        f"drain scenario regressed off the device path: {s}")
+    assert s["full_cycles"] >= 1, s
+
+
 def test_skip_race_matches_host():
     """Two borrowing heads race for the same cohort headroom: the first
     admits, the second must be SKIPPED (scheduler.go:245) — identically on
