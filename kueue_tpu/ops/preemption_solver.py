@@ -21,6 +21,26 @@ from ..api.types import (
 from .packing import pack_cycle
 from .preemption_kernel import minimal_preemptions
 
+_cpu_dev = None
+
+
+def _cpu_device():
+    """Candidate lists are small; a tunneled accelerator's ~100ms round
+    trip would dwarf the search, so the kernel always runs on the XLA CPU
+    backend (identical decisions)."""
+    global _cpu_dev
+    if _cpu_dev is None:
+        import jax
+        try:
+            _cpu_dev = jax.devices("cpu")[0]
+        except RuntimeError:
+            try:
+                jax.config.update("jax_platforms", "cpu")
+            except Exception:
+                pass
+            _cpu_dev = jax.devices("cpu")[0]
+    return _cpu_dev
+
 
 def _bucket(n: int, minimum: int = 8) -> int:
     b = minimum
@@ -30,17 +50,20 @@ def _bucket(n: int, minimum: int = 8) -> int:
 
 
 def device_minimal_preemptions(ctx, candidates, allow_borrowing: bool,
-                               threshold: Optional[int]):
+                               threshold: Optional[int], packed=None):
     """Device twin of Preemptor._minimal_preemptions.
 
-    Returns a list of Targets, [] (search failed), or None (unsupported —
-    run the host path)."""
+    ``packed`` (a PackedCycle for the SAME snapshot at nominate time, e.g.
+    the admission solver's cached-structure pack) avoids re-packing per
+    search.  Returns a list of Targets, [] (search failed), or None
+    (unsupported — run the host path)."""
     from ..scheduler.preemption import Target  # circular-safe import
 
     if not candidates:
         return []
-    packed = pack_cycle(ctx.snapshot, [])
-    if not packed.exact:
+    if packed is None:
+        packed = pack_cycle(ctx.snapshot, [])
+    if packed is None or not packed.exact:
         return None
     cq_idx = {n: i for i, n in enumerate(packed.cq_names)}
     pre_cq = cq_idx.get(ctx.preemptor_cq.name)
@@ -92,12 +115,14 @@ def device_minimal_preemptions(ctx, candidates, allow_borrowing: bool,
         cand_above[i] = (threshold is not None
                          and cand.obj.priority >= threshold)
 
-    fitted, target_mask = minimal_preemptions(
-        packed.usage0, packed.subtree_quota, packed.guaranteed,
-        packed.borrow_cap, packed.has_borrow_limit, packed.parent,
-        pre_cq, wl_usage, frs_mask, cand_cq, cand_delta, cand_other,
-        cand_above, allow_borrowing, threshold is not None,
-        depth=packed.depth)
+    import jax
+    with jax.default_device(_cpu_device()):
+        fitted, target_mask = minimal_preemptions(
+            packed.usage0, packed.subtree_quota, packed.guaranteed,
+            packed.borrow_cap, packed.has_borrow_limit, packed.parent,
+            pre_cq, wl_usage, frs_mask, cand_cq, cand_delta, cand_other,
+            cand_above, allow_borrowing, threshold is not None,
+            depth=packed.depth)
     if not bool(fitted):
         return []
     mask = np.asarray(target_mask)
