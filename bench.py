@@ -147,13 +147,21 @@ def main():
     p99 = cycle_times[int(len(cycle_times) * 0.99)] if cycle_times else 0.0
     aps = finished / wall if wall > 0 else 0.0
     solver_stats = getattr(d.scheduler.solver, "stats", {})
-    # full + host_fallbacks = all cycles with heads (classify-mode cycles
-    # count in host_fallbacks: the host admit loop still ran)
+    # disjoint counters: full (device decided everything), classify
+    # (device nominate + host admit loop), host (pure host fallback)
     full = solver_stats.get("full_cycles", 0)
-    share = 100.0 * full / max(1, full + solver_stats.get("host_fallbacks", 0))
+    classify = solver_stats.get("classify_cycles", 0)
+    host = solver_stats.get("host_cycles", 0)
+    share = 100.0 * full / max(1, full + classify + host)
+    accel = solver_stats.get("accel_dispatches", 0)
     print(f"drained {finished}/{total} in {wall:.2f}s over {cycles} cycles; "
           f"cycle p50={p50 * 1e3:.2f}ms p99={p99 * 1e3:.2f}ms; "
-          f"device-cycle share={share:.1f}% stats={solver_stats}",
+          f"full-device-cycle share={share:.1f}% "
+          f"(accelerator dispatches: {accel}, XLA-CPU: "
+          f"{solver_stats.get('cpu_dispatches', 0)}, scan provably no-op: "
+          f"{solver_stats.get('skipped_dispatches', 0)}+"
+          f"{solver_stats.get('singleton_dispatches', 0)}) "
+          f"stats={solver_stats}",
           file=sys.stderr)
     print(json.dumps({
         "metric": "admissions_per_sec_drain_15k_workloads_30cq",

@@ -435,16 +435,15 @@ class Workload:
     def __post_init__(self):
         if not self.uid:
             self.uid = f"{self.namespace}/{self.name}"
+        # eager: hot identity in cache/queue maps; computing it here (not
+        # lazily) means a later name/namespace mutation can't silently
+        # desync map identity — name immutability is enforced by the
+        # workload webhook, and clone() carries the same identity
+        self._key = f"{self.namespace}/{self.name}"
 
     @property
     def key(self) -> str:
-        # memoized: hot identity in cache/queue maps (name is immutable
-        # after creation, webhook validation enforces it)
-        k = self.__dict__.get("_key")
-        if k is None:
-            k = f"{self.namespace}/{self.name}"
-            self.__dict__["_key"] = k
-        return k
+        return self._key
 
     # -- condition helpers (reference pkg/workload/workload.go:774-789) --
     def condition_true(self, cond_type: str) -> bool:

@@ -153,6 +153,7 @@ class Cache:
             owner = self._find_owner(info)
             if owner is not None:
                 owner.remove_workload(owner.workloads[info.key])
+                self._wl_owner.pop(info.key, None)
             cq = self._mgr.cluster_queues.get(info.obj.admission.cluster_queue)
             if cq is None:
                 self.assumed_workloads.discard(info.key)

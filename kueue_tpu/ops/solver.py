@@ -76,6 +76,19 @@ class DeviceCycleFinal:
     reserve_mask: np.ndarray     # [n] bool (head order)
 
 
+@dataclass
+class DispatchHandle:
+    """An in-flight admit scan: the dispatch has been issued (or decided
+    unnecessary) and the host is free to do per-head work while the device
+    executes; ``CycleSolver.fetch`` blocks for the decisions."""
+    order: np.ndarray
+    rmask: np.ndarray            # [W] bool
+    n: int
+    pending: object = None       # jax array still on device, or None
+    admitted: Optional[np.ndarray] = None  # resolved decisions [W]
+    route: str = ""              # "accel" | "cpu" | "no_fit" | "singleton"
+
+
 class CycleSolver:
     """Batched solver for the admission cycle.
 
@@ -96,14 +109,18 @@ class CycleSolver:
             accel_min_heads = int(os.environ.get(
                 "KUEUE_TPU_ACCEL_MIN_HEADS", "512"))
         self.accel_min_heads = accel_min_heads
+        # Disjoint cycle counters: every cycle with heads lands in exactly
+        # one of full/classify/host (bench derives shares from these).
         self.stats = {
-            "device_cycles": 0,       # cycles with any device decisions
             "full_cycles": 0,         # fully device-decided cycles
             "classify_cycles": 0,     # device nominate + host admit loop
-            "host_fallbacks": 0,      # cycles needing any host assignment
+            "host_cycles": 0,         # pure host fallback (classify=None)
             "reserve_entries": 0,
-            "accel_dispatches": 0,
-            "cpu_dispatches": 0,
+            # dispatch routing within full cycles (also disjoint):
+            "accel_dispatches": 0,    # admit scan ran on the accelerator
+            "cpu_dispatches": 0,      # admit scan ran on the XLA CPU backend
+            "skipped_dispatches": 0,  # no fit head -> scan provably no-op
+            "singleton_dispatches": 0,  # <=1 entry/forest -> no contention
             "structure_rebuilds": 0,
         }
         self._structure: Optional[PackedStructure] = None
@@ -111,6 +128,10 @@ class CycleSolver:
         self._devices_resolved = False
         self._cpu_dev = None
         self._accel_dev = None
+        # measured per-backend admit-scan wall times, filled by warmup:
+        # {("cpu"|"accel", kernel, bucket): seconds}
+        self.calibration: dict[tuple, float] = {}
+        self.rtt_s: Optional[float] = None  # measured accel round-trip
 
     # -- device routing ------------------------------------------------
 
@@ -139,20 +160,55 @@ class CycleSolver:
             return self._cpu_dev
         if self.backend == "accel":
             return self._accel_dev or self._cpu_dev
-        # auto: a tunneled-accelerator round trip is ~100 ms flat; only
-        # cycles with enough heads amortize it
+        # auto without calibration: a tunneled-accelerator round trip can
+        # be ~100 ms flat; only big cycles amortize it
         if self._accel_dev is not None and n_heads >= self.accel_min_heads:
             return self._accel_dev
         return self._cpu_dev
 
+    def _route_device(self, kernel: str, W: int, mfw: Optional[int]):
+        """Pick the backend for one scan dispatch.
+
+        With warmup calibration the choice is MEASURED: the backend whose
+        steady-state (dispatch + readback) wall time for this (kernel,
+        bucket) was lower.  Co-located accelerators (sub-ms dispatch) win
+        everything; a tunneled chip (~100 ms RTT) wins only when the scan
+        compute itself exceeds the tunnel latency.  Falls back to the
+        accel_min_heads heuristic when uncalibrated."""
+        self._resolve_devices()
+        if self.backend in ("cpu", "native"):
+            return self._cpu_dev
+        if self.backend == "accel":
+            return self._accel_dev or self._cpu_dev
+        if self._accel_dev is None:
+            return self._cpu_dev
+        key_len = mfw if mfw is not None else W
+        t_cpu = self.calibration.get(("cpu", kernel, W, key_len))
+        t_acc = self.calibration.get(("accel", kernel, W, key_len))
+        if t_cpu is not None and t_acc is not None:
+            return self._accel_dev if t_acc < t_cpu else self._cpu_dev
+        return self._pick_device(W)
+
     def warmup(self, snapshot: Snapshot, max_heads: int) -> None:
         """One-time setup outside the hot loop: resolve backends (a
-        tunneled TPU client can take tens of seconds to connect) and
-        compile the admit scan for every head-count bucket up to
-        ``max_heads``.  Shapes only — no scheduling state is touched."""
+        tunneled TPU client can take tens of seconds to connect), compile
+        the admit scan for every head-count bucket up to ``max_heads`` on
+        BOTH backends, and record each combination's steady-state wall
+        time — the router dispatches each cycle to whichever backend
+        measured faster.  Shapes only — no scheduling state is touched."""
+        import time as _time
         import jax
         from .packing import _bucket
         self._resolve_devices()
+        if self._accel_dev is not None:
+            # measured accel round trip: tiny transfer + readback
+            one = np.zeros(8, np.int32)
+            with jax.default_device(self._accel_dev):
+                f = jax.jit(lambda x: x + 1)
+                jax.device_get(f(one))
+                t0 = _time.perf_counter()
+                jax.device_get(f(one))
+                self.rtt_s = _time.perf_counter() - t0
         st = self._structure_for(snapshot, [])
         N, F = st.subtree_quota.shape
         C, S, R = st.slot_fr.shape
@@ -172,21 +228,25 @@ class CycleSolver:
                 np.full(W, -1, np.int32), np.zeros(W, bool),
                 np.zeros(W, np.int32), np.zeros(W, bool),
                 np.arange(W, dtype=np.int32))
-            # head counts inside one bucket can route to different
-            # backends when accel_min_heads falls mid-bucket — warm every
-            # device the bucket can reach
-            devs = {self._pick_device(max(1, W // 2 + 1)),
-                    self._pick_device(W)}
+            devs = [self._cpu_dev]
+            if (self._accel_dev is not None
+                    and self.backend in ("auto", "accel")):
+                devs.append(self._accel_dev)
             for dev in devs:
                 # repeat dispatch+readback: the first executions through a
                 # tunneled accelerator are several times slower than
                 # steady state (transport warm-up), and the readback path
-                # is distinct from block_until_ready
-                reps = 3 if dev is self._accel_dev else 1
+                # is distinct from block_until_ready; the LAST rep's time
+                # is the calibration sample
+                name = "accel" if dev is self._accel_dev else "cpu"
+                reps = 3 if dev is self._accel_dev else 2
                 with jax.default_device(dev):
                     if not self._forests_apply(W, st.n_forests):
                         for _ in range(reps):
+                            t0 = _time.perf_counter()
                             jax.device_get(admit_scan(*args, depth=st.depth))
+                            dt = _time.perf_counter() - t0
+                        self.calibration[(name, "flat", W, W)] = dt
                         continue
                     # forest scan lengths: 4 .. bucket(max CQs per forest)
                     C = len(st.cq_names)
@@ -196,9 +256,12 @@ class CycleSolver:
                     mfw = 4
                     while True:
                         for _ in range(reps):
+                            t0 = _time.perf_counter()
                             jax.device_get(admit_scan_forests(
                                 *args, st.forest_of_node, depth=st.depth,
                                 n_forests=st.n_forests, max_forest_wl=mfw))
+                            dt = _time.perf_counter() - t0
+                        self.calibration[(name, "forest", W, mfw)] = dt
                         if mfw >= top:
                             break
                         mfw *= 2
@@ -325,47 +388,91 @@ class CycleSolver:
 
     # -- phase 2 -------------------------------------------------------
 
-    def solve_full(self, cls: ClassifiedCycle,
-                   reserve_mask: np.ndarray) -> DeviceCycleFinal:
-        """Dispatch the admit scan; every entry's decision is final.
+    def dispatch(self, cls: ClassifiedCycle,
+                 reserve_mask: np.ndarray) -> DispatchHandle:
+        """Issue the admit scan (async) — or prove it unnecessary.
 
         ``reserve_mask`` (head order) marks preempt-classified entries the
         scheduler verified have zero preemption candidates — they reserve
-        capacity in-scan (resourcesToReserve) and requeue."""
+        capacity in-scan (resourcesToReserve) and requeue.
+
+        Decision-identical shortcuts (no dispatch issued):
+        - no fit head → nothing can be admitted, reserves requeue anyway;
+        - ≤1 entry per cohort forest → zero within-cycle contention, so
+          every fit head keeps its nominate-time fit.
+        Otherwise the scan is dispatched asynchronously to the calibrated
+        backend; the host overlaps per-head work until ``fetch``."""
         import jax
         packed = cls.packed
         st = packed.structure
         W = packed.wl_cq.shape[0]
+        n = cls.n
         rmask = np.zeros(W, dtype=bool)
         rmask[:len(reserve_mask)] = reserve_mask
         borrows = cls.borrows0 | (cls.preempt_borrows0 & rmask)
         order = cycle_order_np(borrows, packed.wl_priority,
                                packed.wl_timestamp)
-        dev = self._pick_device(cls.n)
+        self.stats["reserve_entries"] += int(rmask[:n].sum())
+        handle = DispatchHandle(order=order, rmask=rmask, n=n)
+
+        fit_mask = cls.fit_slot0 >= 0
+        if not fit_mask[:n].any():
+            self.stats["skipped_dispatches"] += 1
+            handle.admitted = np.zeros(W, dtype=bool)
+            handle.route = "no_fit"
+            return handle
+
+        entry_mask = fit_mask | rmask
+        entry_cqs = packed.wl_cq[entry_mask]
+        if len(entry_cqs):
+            forests = st.forest_of_node[np.maximum(entry_cqs, 0)]
+            if np.bincount(forests, minlength=st.n_forests).max() <= 1:
+                # one entry per independent quota forest: the scan's only
+                # job (usage mutation between entries) is a no-op
+                self.stats["singleton_dispatches"] += 1
+                handle.admitted = fit_mask & (packed.wl_cq >= 0)
+                handle.route = "singleton"
+                return handle
+
+        mfw = self._forest_bucket(packed)
+        kernel = "flat" if mfw is None else "forest"
+        dev = self._route_device(kernel, W, mfw)
         if dev is self._accel_dev and self._accel_dev is not None:
             self.stats["accel_dispatches"] += 1
+            handle.route = "accel"
         else:
             self.stats["cpu_dispatches"] += 1
+            handle.route = "cpu"
         args = (packed.usage0, st.subtree_quota, st.guaranteed,
                 st.borrow_cap, st.has_borrow_limit, st.parent, st.slot_fr,
                 st.nominal_cq, st.nominal_plus_blimit_cq, packed.wl_cq,
                 packed.wl_requests, cls.fit_slot0, rmask,
                 np.maximum(cls.preempt_slot0, 0),
                 cls.preempt_borrows0 & rmask, order)
-        mfw = self._forest_bucket(packed)
         with jax.default_device(dev):
             if mfw is not None:
-                admitted = admit_scan_forests(
+                handle.pending = admit_scan_forests(
                     *args, st.forest_of_node, depth=st.depth,
                     n_forests=st.n_forests, max_forest_wl=mfw)
             else:
-                admitted = admit_scan(*args, depth=st.depth)
-            admitted = np.asarray(jax.device_get(admitted))
-        n = cls.n
-        self.stats["reserve_entries"] += int(rmask[:n].sum())
+                handle.pending = admit_scan(*args, depth=st.depth)
+        return handle
+
+    def fetch(self, handle: DispatchHandle) -> DeviceCycleFinal:
+        """Block for an in-flight scan's decisions (head order)."""
+        if handle.admitted is None:
+            import jax
+            handle.admitted = np.asarray(jax.device_get(handle.pending))
+            handle.pending = None
+        n = handle.n
         return DeviceCycleFinal(
-            order=order[order < n],
-            admitted=admitted[:n], reserve_mask=rmask[:n])
+            order=handle.order[handle.order < n],
+            admitted=handle.admitted[:n], reserve_mask=handle.rmask[:n])
+
+    def solve_full(self, cls: ClassifiedCycle,
+                   reserve_mask: np.ndarray) -> DeviceCycleFinal:
+        """dispatch + fetch in one call (tests/probes)."""
+        return self.fetch(self.dispatch(cls, reserve_mask))
 
     @staticmethod
     def _forests_apply(W: int, n_forests: int) -> bool:
@@ -498,12 +605,12 @@ class CycleSolver:
         preempt-capable head, or unsupported semantics)."""
         cls = self.classify(snapshot, heads)
         if cls is None:
-            self.stats["host_fallbacks"] += 1
+            self.stats["host_cycles"] += 1
             return None
         if cls.preempt0[:cls.n].any():
-            self.stats["host_fallbacks"] += 1
+            self.stats["host_cycles"] += 1
             return None
-        self.stats["device_cycles"] += 1
+        self.stats["classify_cycles"] += 1
         out: dict[str, Assignment] = {}
         for wi in range(cls.n):
             if cls.fit_slot0[wi] >= 0:

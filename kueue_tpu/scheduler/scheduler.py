@@ -63,6 +63,7 @@ class Entry:
     requeue_reason: RequeueReason = RequeueReason.GENERIC
     preemption_targets: list[Target] = field(default_factory=list)
     cq_snapshot: Optional[CQState] = None
+    prepped: Optional[tuple] = None   # (new_wl, new_info) built pre-assume
 
     @property
     def obj(self) -> Workload:
@@ -262,7 +263,7 @@ class Scheduler:
                if solver is not None else None)
         if cls is None:
             if solver is not None:
-                solver.stats["host_fallbacks"] += 1
+                solver.stats["host_cycles"] += 1
             for e in deferred:
                 e.inadmissible_msg = ""
                 self._assign_entry(e, snapshot)
@@ -293,8 +294,6 @@ class Scheduler:
 
         if not full_ok:
             solver.stats["classify_cycles"] += 1
-            solver.stats["device_cycles"] += 1
-            solver.stats["host_fallbacks"] += 1
             for wi, e in enumerate(deferred):
                 e.inadmissible_msg = ""
                 if cls.fit_slot0[wi] >= 0:
@@ -306,38 +305,31 @@ class Scheduler:
                     self._assign_entry(e, snapshot)
             return None
 
-        final = solver.solve_full(cls, reserve)
+        handle = solver.dispatch(cls, reserve)
         solver.stats["full_cycles"] += 1
-        solver.stats["device_cycles"] += 1
-        return (deferred, cls, final)
+        return (deferred, cls, handle)
 
     def _admit_device_cycle(self, device, snapshot: Snapshot,
                             stats: CycleStats) -> None:
         """Apply a fully device-decided cycle: admit in cycle order, mark
         in-scan losers skipped, reserve-and-requeue candidate-less preempt
-        heads (decision-identical to the host admit loop)."""
-        deferred, cls, final = device
+        heads (decision-identical to the host admit loop).
+
+        The scan is still in flight when this starts — all per-head host
+        work whose outcome doesn't depend on the scan (fit assignments,
+        reserve messages, NoFit walks, speculative admit objects) runs
+        FIRST, overlapped with the device execution; ``solver.fetch`` then
+        blocks only for whatever latency is left."""
+        deferred, cls, handle = device
         solver = self.solver
-        for wi in final.order:
-            wi = int(wi)
+        n = cls.n
+        for wi in range(n):
             e = deferred[wi]
-            cq = snapshot.cq(e.info.cluster_queue)
-            if final.admitted[wi]:
+            if cls.fit_slot0[wi] >= 0:
                 e.assignment = solver.build_fit_assignment(cls, wi)
                 e.info.last_assignment = e.assignment.last_state
                 e.inadmissible_msg = ""
-                e.status = EntryStatus.NOMINATED
-                if self._admit(e, cq):
-                    stats.admitted.append(e.info.key)
-                else:
-                    e.inadmissible_msg = "Failed to admit workload"
-            elif cls.fit_slot0[wi] >= 0:
-                # fit at nominate, lost capacity in-scan (scheduler.go:245)
-                e.assignment = solver.build_fit_assignment(cls, wi)
-                e.info.last_assignment = e.assignment.last_state
-                self._set_skipped(e, "Workload no longer fits after "
-                                     "processing another workload")
-            elif final.reserve_mask[wi]:
+            elif handle.rmask[wi]:
                 e.assignment, e.inadmissible_msg = solver.reserve_details(
                     cls, wi)
                 e.info.last_assignment = e.assignment.last_state
@@ -346,6 +338,31 @@ class Scheduler:
                 # resume state
                 e.inadmissible_msg = ""
                 self._assign_entry(e, snapshot)
+        if handle.route == "accel":
+            # the round trip dwarfs per-head prep: speculatively build the
+            # admission objects for every fit head while the chip works
+            for wi in range(n):
+                e = deferred[wi]
+                if cls.fit_slot0[wi] >= 0:
+                    cq = snapshot.cq(e.info.cluster_queue)
+                    if cq is not None:
+                        self._prepare_admit(e, cq)
+
+        final = solver.fetch(handle)
+        for wi in final.order:
+            wi = int(wi)
+            e = deferred[wi]
+            cq = snapshot.cq(e.info.cluster_queue)
+            if final.admitted[wi]:
+                e.status = EntryStatus.NOMINATED
+                if self._admit(e, cq):
+                    stats.admitted.append(e.info.key)
+                else:
+                    e.inadmissible_msg = "Failed to admit workload"
+            elif cls.fit_slot0[wi] >= 0:
+                # fit at nominate, lost capacity in-scan (scheduler.go:245)
+                self._set_skipped(e, "Workload no longer fits after "
+                                     "processing another workload")
 
     @staticmethod
     def _has_retry_or_rejected_checks(wl: Workload) -> bool:
@@ -536,8 +553,11 @@ class Scheduler:
         e.inadmissible_msg = message
         e.requeue_reason = RequeueReason.GENERIC
 
-    def _admit(self, e: Entry, cq: CQState) -> bool:
-        """reference scheduler.go:490 admit."""
+    def _prepare_admit(self, e: Entry, cq: CQState) -> tuple:
+        """Build the admission objects for an entry (reference
+        scheduler.go:490 admit, the pure part before assume/apply).  Safe
+        to run speculatively — nothing is committed; the device path calls
+        this while the admit scan is still in flight."""
         now = self.clock()
         new_wl = e.obj.clone()
         admission = Admission(cluster_queue=e.info.cluster_queue,
@@ -552,6 +572,12 @@ class Scheduler:
         sync_admitted_condition(new_wl, now)
         new_info = Info(new_wl, self.cache.info_options)
         new_info.cluster_queue = e.info.cluster_queue
+        e.prepped = (new_wl, new_info)
+        return e.prepped
+
+    def _admit(self, e: Entry, cq: CQState) -> bool:
+        """reference scheduler.go:490 admit."""
+        new_wl, new_info = e.prepped or self._prepare_admit(e, cq)
         if not self.cache.assume_workload(new_info):
             return False
         e.status = EntryStatus.ASSUMED

@@ -153,7 +153,7 @@ def test_end_to_end_parity_host_vs_device(seed):
     host, device = results
     assert host == device
     # ensure the device path actually ran (not a host-vs-host comparison)
-    assert d.scheduler.solver.stats["device_cycles"] >= 1, \
+    assert (d.scheduler.solver.stats["full_cycles"] + d.scheduler.solver.stats["classify_cycles"]) >= 1, \
         d.scheduler.solver.stats
 
 
@@ -175,14 +175,16 @@ def test_device_solver_used_and_falls_back():
                                pod_sets=[PodSet(name="main", count=1,
                                                 requests={"cpu": 2000})]))
     d.run_until_settled()
-    assert d.scheduler.solver.stats["device_cycles"] >= 1
+    assert (d.scheduler.solver.stats["full_cycles"] + d.scheduler.solver.stats["classify_cycles"]) >= 1
     # higher-priority arrival requires preemption -> host fallback
     d.create_workload(Workload(name="high", queue_name="lq", priority=100,
                                creation_time=2.0,
                                pod_sets=[PodSet(name="main", count=1,
                                                 requests={"cpu": 2000})]))
     d.run_until_settled()
-    assert d.scheduler.solver.stats["host_fallbacks"] >= 1
+    # a preempt head with candidates drops the cycle to classify mode:
+    # device nominate + host admit loop
+    assert d.scheduler.solver.stats["classify_cycles"] >= 1
     assert d.admitted_keys() == {"default/high"}
 
 
@@ -208,7 +210,7 @@ def test_device_solver_charges_pods_quota():
             pod_sets=[PodSet(name="main", count=2,
                              requests={"cpu": 1000})]))
     d.run_until_settled()
-    assert d.scheduler.solver.stats["device_cycles"] >= 1
+    assert (d.scheduler.solver.stats["full_cycles"] + d.scheduler.solver.stats["classify_cycles"]) >= 1
     # pods quota is 3; each workload is 2 pods -> only one admitted
     assert d.admitted_keys() == {"default/w0"}
     fr_pods = FlavorResource("default", "pods")
