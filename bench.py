@@ -50,7 +50,11 @@ CLASSES = [                        # (count/CQ, units, priority)
     ("medium", 100, 5, 100),
     ("large", 50, 20, 200),
 ]
-RUNTIME_CYCLES = 2                 # fake execution length per workload
+# Fake execution length per workload, in cycles.  The reference scenario
+# runs workloads for 30-60s against arrival intervals of 0.1-1.2s
+# (default_generator_config.yaml) — occupancy far outlasts arrival, which
+# is what makes the high-priority wave preempt instead of just waiting.
+RUNTIME_CYCLES = 10
 
 
 class VirtualClock:
@@ -67,6 +71,7 @@ def build(scale: float):
                use_device_solver=os.environ.get("BENCH_DEVICE", "1") == "1")
     d.apply_resource_flavor(ResourceFlavor(name="default"))
     total = 0
+    waves: dict[str, list[Workload]] = {c[0]: [] for c in CLASSES}
     for c in range(N_COHORTS):
         for q in range(CQS_PER_COHORT):
             name = f"cq-{c}-{q}"
@@ -87,19 +92,29 @@ def build(scale: float):
                 for k in range(max(1, int(count * scale))):
                     i += 1
                     total += 1
-                    d.create_workload(Workload(
+                    waves[cls].append(Workload(
                         name=f"{cls}-{c}-{q}-{k}", queue_name=f"lq-{c}-{q}",
                         priority=prio, creation_time=float(total),
                         pod_sets=[PodSet(name="main", count=1,
                                          requests={"cpu": units * UNIT})]))
-    return d, clock, total
+    return d, clock, total, waves
 
 
-def run(d: Driver, clock: VirtualClock, total: int):
+# Arrival staggering (mirrors the reference runner's per-class creation
+# intervals, default_generator_config.yaml: small every 100ms, medium
+# every 500ms, large every 1200ms): the low-priority small wave arrives
+# first and fills quota, so the later high-priority large wave must
+# PREEMPT its way in — the drain exercises the real preemption path, not
+# just priority-ordered admission.
+WAVE_AT_CYCLE = {"small": 0, "medium": 4, "large": 8}
+
+
+def run(d: Driver, clock: VirtualClock, total: int, waves):
     finished = 0
     running: list[tuple[int, str]] = []   # (finish_at_cycle, key)
     cycle = 0
     cycle_times = []
+    preempted_total = 0
     if d.scheduler.solver is not None:
         # one-time setup (backend connect + kernel compile), like the
         # reference perf harness excluding manager startup
@@ -108,13 +123,21 @@ def run(d: Driver, clock: VirtualClock, total: int):
                                   len(d.cache.cluster_queue_names()))
         print(f"solver warmup {time.perf_counter() - t_w:.2f}s",
               file=sys.stderr)
+    pending_waves = sorted(waves.items(),
+                           key=lambda kv: WAVE_AT_CYCLE[kv[0]])
     t0 = time.perf_counter()
     while finished < total:
+        for cls, wls in list(pending_waves):
+            if cycle >= WAVE_AT_CYCLE[cls]:
+                for wl in wls:
+                    d.create_workload(wl)
+                pending_waves.remove((cls, wls))
         cycle += 1
         clock.t += 1.0
         c0 = time.perf_counter()
         stats = d.schedule_once()
         cycle_times.append(time.perf_counter() - c0)
+        preempted_total += len(stats.preempted_targets)
         for key in stats.admitted:
             running.append((cycle + RUNTIME_CYCLES, key))
         still = []
@@ -133,15 +156,17 @@ def run(d: Driver, clock: VirtualClock, total: int):
                   file=sys.stderr)
             break
     wall = time.perf_counter() - t0
-    return wall, cycle, cycle_times, finished
+    return wall, cycle, cycle_times, finished, preempted_total
 
 
 def main():
     scale = float(os.environ.get("BENCH_SCALE", "1.0"))
-    d, clock, total = build(scale)
+    d, clock, total, waves = build(scale)
     print(f"scenario: {N_COHORTS * CQS_PER_COHORT} CQs, {total} workloads, "
-          f"scale={scale}", file=sys.stderr)
-    wall, cycles, cycle_times, finished = run(d, clock, total)
+          f"scale={scale}, staggered arrival {WAVE_AT_CYCLE}",
+          file=sys.stderr)
+    wall, cycles, cycle_times, finished, preempted = run(d, clock, total,
+                                                         waves)
     cycle_times.sort()
     p50 = cycle_times[len(cycle_times) // 2] if cycle_times else 0.0
     p99 = cycle_times[int(len(cycle_times) * 0.99)] if cycle_times else 0.0
@@ -154,14 +179,16 @@ def main():
     host = solver_stats.get("host_cycles", 0)
     share = 100.0 * full / max(1, full + classify + host)
     accel = solver_stats.get("accel_dispatches", 0)
-    print(f"drained {finished}/{total} in {wall:.2f}s over {cycles} cycles; "
+    pre_stats = d.scheduler.preemptor.stats
+    print(f"drained {finished}/{total} in {wall:.2f}s over {cycles} cycles "
+          f"({preempted} preemptions); "
           f"cycle p50={p50 * 1e3:.2f}ms p99={p99 * 1e3:.2f}ms; "
           f"full-device-cycle share={share:.1f}% "
           f"(accelerator dispatches: {accel}, XLA-CPU: "
           f"{solver_stats.get('cpu_dispatches', 0)}, scan provably no-op: "
           f"{solver_stats.get('skipped_dispatches', 0)}+"
           f"{solver_stats.get('singleton_dispatches', 0)}) "
-          f"stats={solver_stats}",
+          f"stats={solver_stats} preemptor={pre_stats}",
           file=sys.stderr)
     print(json.dumps({
         "metric": "admissions_per_sec_drain_15k_workloads_30cq",

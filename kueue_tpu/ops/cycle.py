@@ -119,6 +119,11 @@ def classify_np(packed, avail0=None, potential0=None):
     preempt_borrows0 = borrows_s[w, p_idx] & has_preempt
     # per-resource fit on the preempt slot (for frs_need_preemption)
     preempt_res_fit = fit_r[w, p_idx] | ~relevant[w, p_idx]
+    # how many slots are preempt-capable: with exactly one, the host walk
+    # picks it regardless of the reclaim oracle (the oracle only reorders
+    # among preempt-capable flavors — flavorassigner.go:692 RECLAIM vs
+    # PREEMPT), so the device may fix the slot without running the oracle
+    preempt_slot_count = preempt_s.sum(axis=1).astype(np.int32)
 
     return {
         "fit_slot0": fit_slot0,
@@ -127,6 +132,7 @@ def classify_np(packed, avail0=None, potential0=None):
         "preempt_slot0": preempt_slot0,
         "preempt_borrows0": preempt_borrows0,
         "preempt_res_fit": preempt_res_fit,
+        "preempt_slot_count": preempt_slot_count,
         "avail0": avail0,
         "potential0": potential0,
     }
@@ -219,6 +225,161 @@ def admit_scan(usage0, subtree, guaranteed, borrow_cap, has_blim, parent,
                    reserve_borrows=reserve_borrows, depth=depth)
     _, admit_o = jax.lax.scan(step, usage0, order)
     return jnp.zeros(W, dtype=bool).at[order].set(admit_o)
+
+
+# ----------------------------------------------------------------------
+# Preemption-aware admit scan (cycles whose preempt heads have targets)
+# ----------------------------------------------------------------------
+
+def _remove_usage_chain(usage, node, delta, guaranteed, parent, depth):
+    """remove_usage bubbling up one ancestor chain (resource_node.go:135)."""
+    def body(i, state):
+        usage, cur, carry = state
+        valid = cur >= 0
+        cur_safe = jnp.maximum(cur, 0)
+        stored_in_parent = usage[cur_safe] - guaranteed[cur_safe]
+        sub = jnp.where(valid, carry, 0)
+        usage = usage.at[cur_safe].add(-sub)
+        next_carry = jnp.where(stored_in_parent > 0,
+                               jnp.minimum(carry, stored_in_parent), 0)
+        next_cur = jnp.where(valid, parent[cur_safe], -1)
+        return usage, next_cur, jnp.where(valid, next_carry, carry)
+
+    usage, _, _ = jax.lax.fori_loop(
+        0, depth, body, (usage, node.astype(jnp.int32), delta))
+    return usage
+
+
+def _preempt_entry_decision(avail_check, usage, usage_check, used, wi, valid,
+                            *, slot_fr, nominal_cq, npb_cq, wl_cq,
+                            wl_requests, decision_slot, reserve_mask,
+                            reserve_slot, reserve_borrows, preempt_mask,
+                            preempt_slot, tgt_mat, tu_cq, tu_delta,
+                            guaranteed, parent, subtree, borrow_cap,
+                            has_blim, depth):
+    """One entry of the preemption-aware admit loop.
+
+    Mirrors the reference admit loop (scheduler.go:211-284) with
+    preemptions: every fits check runs against usage minus the
+    already-preempted targets (scheduler.go:372 fits under
+    PreemptedWorkloads), preempt entries remove their own targets first
+    (_fits_with_removal), overlapping targets skip the entry, and both
+    admitted and preempting entries charge their usage forward.
+
+    Returns (admit, preempting, overlap_skip, node, delta_f, u_try,
+    used_next): ``node`` is the CQ to charge (-1 no-op); ``u_try`` is the
+    check-usage after this entry's target removals (committed by the
+    caller only when the entry preempts)."""
+    wis = jnp.maximum(wi, 0)
+    cq = jnp.maximum(wl_cq[wis], 0)
+    req = wl_requests[wis]
+    F = usage.shape[1]
+    MT = tgt_mat.shape[1]
+
+    # --- fit entry: re-check the fixed slot against avail_check ---
+    slot = decision_slot[wis]
+    is_fit = (slot >= 0) & valid
+    frs = slot_fr[cq, jnp.maximum(slot, 0)]
+    frs_safe = jnp.maximum(frs, 0)
+    relevant = (frs >= 0) & (req > 0)
+    fit_ok = jnp.all(jnp.where(relevant, req <= avail_check[cq][frs_safe],
+                               True))
+    admit = is_fit & fit_ok
+    delta_f = jnp.zeros(F, dtype=usage.dtype).at[frs_safe].add(
+        jnp.where(relevant & admit, req, 0))
+
+    # --- preempt entry: overlap check + remove targets + fits ---
+    is_pre = preempt_mask[wis] & valid
+    tgts = tgt_mat[wis]                                    # [MT]
+    t_valid = tgts >= 0
+    t_safe = jnp.maximum(tgts, 0)
+    overlap = jnp.any(used[t_safe] & t_valid)
+    overlap_skip = is_pre & overlap
+    act_pre = is_pre & ~overlap
+
+    def rm(j, u):
+        do = t_valid[j] & act_pre
+        u2 = _remove_usage_chain(u, tu_cq[t_safe[j]], tu_delta[t_safe[j]],
+                                 guaranteed, parent, depth)
+        return jnp.where(do, u2, u)
+
+    u_try = jax.lax.fori_loop(0, MT, rm, usage_check)
+    avail_try = available_all(u_try, subtree, guaranteed, borrow_cap,
+                              has_blim, parent, depth)
+    pfrs = slot_fr[cq, jnp.maximum(preempt_slot[wis], 0)]
+    pfrs_safe = jnp.maximum(pfrs, 0)
+    p_rel = (pfrs >= 0) & (req > 0)
+    pre_ok = jnp.all(jnp.where(p_rel, req <= avail_try[cq][pfrs_safe], True))
+    preempting = act_pre & pre_ok
+    pre_delta = jnp.zeros(F, dtype=usage.dtype).at[pfrs_safe].add(
+        jnp.where(p_rel & preempting, req, 0))
+    delta_f = delta_f + pre_delta
+    # max-scatter: pads share index 0 with real targets; a duplicate
+    # .set's winner is undefined, while max(used, mark) is order-free
+    used_next = used.at[t_safe].max(t_valid & preempting)
+
+    # --- reserve entry (unchanged semantics) ---
+    is_res = reserve_mask[wis] & valid
+    rfrs = slot_fr[cq, jnp.maximum(reserve_slot[wis], 0)]
+    rfrs_safe = jnp.maximum(rfrs, 0)
+    rrel = (rfrs >= 0) & (req > 0)
+    cur = usage[cq][rfrs_safe]
+    res_borrow = jnp.minimum(req, npb_cq[cq][rfrs_safe] - cur)
+    res_nob = jnp.maximum(0, jnp.minimum(req, nominal_cq[cq][rfrs_safe] - cur))
+    rdelta = jnp.where(reserve_borrows[wis], res_borrow, res_nob)
+    delta_f = delta_f.at[rfrs_safe].add(jnp.where(rrel & is_res, rdelta, 0))
+
+    node = jnp.where(admit | preempting | is_res, wl_cq[wis], -1)
+    return admit, preempting, overlap_skip, node, delta_f, u_try, used_next
+
+
+@partial(jax.jit, static_argnames=("depth",))
+def admit_scan_preempt(usage0, subtree, guaranteed, borrow_cap, has_blim,
+                       parent, slot_fr, nominal_cq, npb_cq, wl_cq,
+                       wl_requests, decision_slot, reserve_mask,
+                       reserve_slot, reserve_borrows, preempt_mask,
+                       preempt_slot, tgt_mat, tu_cq, tu_delta, order,
+                       *, depth: int):
+    """``admit_scan`` extended with preempting entries.
+
+    Carries (usage, usage_check, used): ``usage`` follows the reference's
+    live snapshot (admits + reserves + preemptor additions, targets NOT
+    removed — scheduler.go:272 simulate), ``usage_check`` additionally has
+    every preempted target removed (the state `fits` checks against,
+    scheduler.go:372-381), ``used`` is the PreemptedWorkloads set.
+
+    Returns (admitted[W], preempting[W], overlap_skip[W]) in head order."""
+    W = wl_cq.shape[0]
+    T = tu_cq.shape[0]
+
+    def step(carry, wi):
+        usage, usage_check, used = carry
+        avail_check = available_all(usage_check, subtree, guaranteed,
+                                    borrow_cap, has_blim, parent, depth)
+        admit, preempting, overlap_skip, node, delta_f, u_try, used = (
+            _preempt_entry_decision(
+                avail_check, usage, usage_check, used, wi, wl_cq[wi] >= 0,
+                slot_fr=slot_fr, nominal_cq=nominal_cq, npb_cq=npb_cq,
+                wl_cq=wl_cq, wl_requests=wl_requests,
+                decision_slot=decision_slot, reserve_mask=reserve_mask,
+                reserve_slot=reserve_slot, reserve_borrows=reserve_borrows,
+                preempt_mask=preempt_mask, preempt_slot=preempt_slot,
+                tgt_mat=tgt_mat, tu_cq=tu_cq, tu_delta=tu_delta,
+                guaranteed=guaranteed, parent=parent, subtree=subtree,
+                borrow_cap=borrow_cap, has_blim=has_blim, depth=depth))
+        usage = add_usage_chain(usage, node, delta_f, guaranteed, parent,
+                                depth)
+        base_check = jnp.where(preempting, u_try, usage_check)
+        usage_check = add_usage_chain(base_check, node, delta_f, guaranteed,
+                                      parent, depth)
+        return (usage, usage_check, used), (admit, preempting, overlap_skip)
+
+    used0 = jnp.zeros(T, dtype=bool)
+    _, (admit_o, pre_o, skip_o) = jax.lax.scan(
+        step, (usage0, usage0, used0), order)
+    z = jnp.zeros(W, dtype=bool)
+    return (z.at[order].set(admit_o), z.at[order].set(pre_o),
+            z.at[order].set(skip_o))
 
 
 # ----------------------------------------------------------------------

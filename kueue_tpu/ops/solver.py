@@ -40,7 +40,8 @@ from ..scheduler.flavorassigner import (
 from ..resources import FlavorResource, FlavorResourceQuantities, Requests
 from .packing import (PackedCycle, PackedStructure, _bucket, pack_cycle,
                       pack_structure)
-from .cycle import admit_scan, admit_scan_forests, classify_np, cycle_order_np
+from .cycle import (admit_scan, admit_scan_forests, admit_scan_preempt,
+                    classify_np, cycle_order_np)
 
 # A flat admit scan is one lax.scan step per head; the forest-parallel
 # variant processes one head per cohort forest per step.  Below this head
@@ -62,10 +63,20 @@ class ClassifiedCycle:
     preempt_slot0: np.ndarray    # [W] int32
     preempt_borrows0: np.ndarray  # [W] bool
     preempt_res_fit: np.ndarray  # [W, R] bool
+    preempt_slot_count: np.ndarray = None  # [W] int32 preempt-capable slots
 
     @property
     def n(self) -> int:
         return self.packed.wl_count
+
+
+@dataclass
+class PackedTargets:
+    """Per-cycle preemption-target tensors for the admit scan."""
+    preempt_mask: np.ndarray     # [W] bool
+    tgt_mat: np.ndarray          # [W, MT] int32 universe indices, -1 pad
+    tu_cq: np.ndarray            # [T] int32 node index
+    tu_delta: np.ndarray         # [T, F] int32 scaled usage
 
 
 @dataclass
@@ -74,6 +85,8 @@ class DeviceCycleFinal:
     order: np.ndarray            # [n] head indices, cycle order
     admitted: np.ndarray         # [n] bool (head order)
     reserve_mask: np.ndarray     # [n] bool (head order)
+    preempting: np.ndarray = None    # [n] bool: issued preemptions
+    overlap_skip: np.ndarray = None  # [n] bool: overlapping targets
 
 
 @dataclass
@@ -84,8 +97,10 @@ class DispatchHandle:
     order: np.ndarray
     rmask: np.ndarray            # [W] bool
     n: int
-    pending: object = None       # jax array still on device, or None
+    pending: object = None       # jax array(s) still on device, or None
     admitted: Optional[np.ndarray] = None  # resolved decisions [W]
+    preempting: Optional[np.ndarray] = None
+    overlap_skip: Optional[np.ndarray] = None
     route: str = ""              # "accel" | "cpu" | "no_fit" | "singleton"
 
 
@@ -265,6 +280,25 @@ class CycleSolver:
                         if mfw >= top:
                             break
                         mfw *= 2
+            # preemption-aware scan: warm + calibrate the common
+            # small-target-universe buckets (T=8, MT=2); bigger universes
+            # still compile on first use
+            T, MT = 8, 2
+
+            pargs = args[:-1] + (
+                np.zeros(W, bool), np.zeros(W, np.int32),
+                np.full((W, MT), -1, np.int32), np.zeros(T, np.int32),
+                np.zeros((T, F), np.int32), args[-1])
+            for dev in devs:
+                name = "accel" if dev is self._accel_dev else "cpu"
+                reps = 3 if dev is self._accel_dev else 2
+                with jax.default_device(dev):
+                    for _ in range(reps):
+                        t0 = _time.perf_counter()
+                        jax.device_get(admit_scan_preempt(
+                            *pargs, depth=st.depth))
+                        dt = _time.perf_counter() - t0
+                self.calibration[(name, "preempt", W, W)] = dt
 
     # -- structure cache -----------------------------------------------
 
@@ -369,13 +403,14 @@ class CycleSolver:
                 "preempt_slot0": np.full(W, -1, np.int32),
                 "preempt_borrows0": np.zeros(W, bool),
                 "preempt_res_fit": np.ones((W, R), bool),
+                "preempt_slot_count": np.zeros(W, np.int32),
             }
             if out["preempt0"][:n].any():
                 # the C++ core covers fit/borrow/preempt-possible; the
                 # preempt-slot details come from the numpy pass on demand
                 det = classify_np(packed, potential0=self._potential0)
                 for k in ("preempt_slot0", "preempt_borrows0",
-                          "preempt_res_fit"):
+                          "preempt_res_fit", "preempt_slot_count"):
                     out[k] = det[k]
         else:
             out = classify_np(packed, potential0=self._potential0)
@@ -384,22 +419,95 @@ class CycleSolver:
             fit_slot0=out["fit_slot0"], borrows0=out["borrows0"],
             preempt0=out["preempt0"], preempt_slot0=out["preempt_slot0"],
             preempt_borrows0=out["preempt_borrows0"],
-            preempt_res_fit=out["preempt_res_fit"])
+            preempt_res_fit=out["preempt_res_fit"],
+            preempt_slot_count=out["preempt_slot_count"])
 
     # -- phase 2 -------------------------------------------------------
 
-    def dispatch(self, cls: ClassifiedCycle,
-                 reserve_mask: np.ndarray) -> DispatchHandle:
+    def pack_targets(self, cls: ClassifiedCycle,
+                     targets_by_wi: dict) -> Optional[PackedTargets]:
+        """Pack per-head preemption-target lists into scan tensors.
+
+        ``targets_by_wi``: {head index: [Target]} from the preemptor's
+        nominate-time searches.  Returns None when a target's usage can't
+        be represented exactly in the cached structure (host fallback)."""
+        packed = cls.packed
+        st = packed.structure
+        W = packed.wl_cq.shape[0]
+        F = packed.usage0.shape[1]
+        universe: list = []
+        uni_idx: dict[str, int] = {}
+        scale_of = {r: int(st.resource_scale[i])
+                    for i, r in enumerate(st.resource_names)}
+
+        def to_f_vec(frq) -> Optional[np.ndarray]:
+            vec = np.zeros(F, dtype=np.int64)
+            for fr, v in frq.items():
+                fi = st.fr_index.get(fr)
+                if fi is None:
+                    return None
+                s = scale_of[fr.resource]
+                if v % s:
+                    return None
+                vec[fi] += v // s
+            if vec.max(initial=0) > 2**31 - 1:
+                return None
+            return vec.astype(np.int32)
+
+        deltas: list[np.ndarray] = []
+        cqs: list[int] = []
+        per_wi: dict[int, list[int]] = {}
+        for wi, targets in targets_by_wi.items():
+            idxs = []
+            for t in targets:
+                key = t.info.key
+                ti = uni_idx.get(key)
+                if ti is None:
+                    ci = st.cq_index.get(t.info.cluster_queue)
+                    if ci is None:
+                        return None
+                    delta = to_f_vec(t.info.usage())
+                    if delta is None:
+                        return None
+                    ti = len(universe)
+                    uni_idx[key] = ti
+                    universe.append(t.info)
+                    deltas.append(delta)
+                    cqs.append(ci)
+                idxs.append(ti)
+            per_wi[wi] = idxs
+
+        T = _bucket(max(1, len(universe)), minimum=8)
+        MT = _bucket(max(1, max(len(v) for v in per_wi.values())), minimum=2)
+        tu_cq = np.zeros(T, dtype=np.int32)
+        tu_delta = np.zeros((T, F), dtype=np.int32)
+        tu_cq[:len(cqs)] = cqs
+        if deltas:
+            tu_delta[:len(deltas)] = np.stack(deltas)
+        tgt_mat = np.full((W, MT), -1, dtype=np.int32)
+        preempt_mask = np.zeros(W, dtype=bool)
+        for wi, idxs in per_wi.items():
+            preempt_mask[wi] = True
+            tgt_mat[wi, :len(idxs)] = idxs
+        return PackedTargets(preempt_mask=preempt_mask, tgt_mat=tgt_mat,
+                             tu_cq=tu_cq, tu_delta=tu_delta)
+
+    def dispatch(self, cls: ClassifiedCycle, reserve_mask: np.ndarray,
+                 targets: Optional[PackedTargets] = None) -> DispatchHandle:
         """Issue the admit scan (async) — or prove it unnecessary.
 
         ``reserve_mask`` (head order) marks preempt-classified entries the
         scheduler verified have zero preemption candidates — they reserve
-        capacity in-scan (resourcesToReserve) and requeue.
+        capacity in-scan (resourcesToReserve) and requeue.  ``targets``
+        carries the packed preemption targets for preempt heads WITH
+        candidates; those entries preempt in-scan (the reference admit
+        loop's IssuePreemptions branch, scheduler.go:176-284).
 
         Decision-identical shortcuts (no dispatch issued):
-        - no fit head → nothing can be admitted, reserves requeue anyway;
-        - ≤1 entry per cohort forest → zero within-cycle contention, so
-          every fit head keeps its nominate-time fit.
+        - no fit head and no preempt entry → nothing can be admitted,
+          reserves requeue anyway;
+        - ≤1 entry per cohort forest (and no preempt entry) → zero
+          within-cycle contention, every fit head keeps its fit.
         Otherwise the scan is dispatched asynchronously to the calibrated
         backend; the host overlaps per-head work until ``fetch``."""
         import jax
@@ -409,33 +517,40 @@ class CycleSolver:
         n = cls.n
         rmask = np.zeros(W, dtype=bool)
         rmask[:len(reserve_mask)] = reserve_mask
-        borrows = cls.borrows0 | (cls.preempt_borrows0 & rmask)
+        pmask = (targets.preempt_mask if targets is not None
+                 else np.zeros(W, dtype=bool))
+        borrows = cls.borrows0 | (cls.preempt_borrows0 & (rmask | pmask))
         order = cycle_order_np(borrows, packed.wl_priority,
                                packed.wl_timestamp)
         self.stats["reserve_entries"] += int(rmask[:n].sum())
         handle = DispatchHandle(order=order, rmask=rmask, n=n)
+        zeros = np.zeros(W, dtype=bool)
 
         fit_mask = cls.fit_slot0 >= 0
-        if not fit_mask[:n].any():
-            self.stats["skipped_dispatches"] += 1
-            handle.admitted = np.zeros(W, dtype=bool)
-            handle.route = "no_fit"
-            return handle
-
-        entry_mask = fit_mask | rmask
-        entry_cqs = packed.wl_cq[entry_mask]
-        if len(entry_cqs):
-            forests = st.forest_of_node[np.maximum(entry_cqs, 0)]
-            if np.bincount(forests, minlength=st.n_forests).max() <= 1:
-                # one entry per independent quota forest: the scan's only
-                # job (usage mutation between entries) is a no-op
-                self.stats["singleton_dispatches"] += 1
-                handle.admitted = fit_mask & (packed.wl_cq >= 0)
-                handle.route = "singleton"
+        if not pmask.any():
+            handle.preempting = zeros
+            handle.overlap_skip = zeros
+            if not fit_mask[:n].any():
+                self.stats["skipped_dispatches"] += 1
+                handle.admitted = zeros
+                handle.route = "no_fit"
                 return handle
+            entry_mask = fit_mask | rmask
+            entry_cqs = packed.wl_cq[entry_mask]
+            if len(entry_cqs):
+                forests = st.forest_of_node[np.maximum(entry_cqs, 0)]
+                if np.bincount(forests, minlength=st.n_forests).max() <= 1:
+                    # one entry per independent quota forest: the scan's
+                    # only job (usage mutation between entries) is a no-op
+                    self.stats["singleton_dispatches"] += 1
+                    handle.admitted = fit_mask & (packed.wl_cq >= 0)
+                    handle.route = "singleton"
+                    return handle
 
-        mfw = self._forest_bucket(packed)
-        kernel = "flat" if mfw is None else "forest"
+        has_preempt = bool(pmask.any())
+        mfw = self._forest_bucket(packed) if not has_preempt else None
+        kernel = ("preempt" if has_preempt
+                  else "flat" if mfw is None else "forest")
         dev = self._route_device(kernel, W, mfw)
         if dev is self._accel_dev and self._accel_dev is not None:
             self.stats["accel_dispatches"] += 1
@@ -448,26 +563,42 @@ class CycleSolver:
                 st.nominal_cq, st.nominal_plus_blimit_cq, packed.wl_cq,
                 packed.wl_requests, cls.fit_slot0, rmask,
                 np.maximum(cls.preempt_slot0, 0),
-                cls.preempt_borrows0 & rmask, order)
+                cls.preempt_borrows0 & rmask)
         with jax.default_device(dev):
-            if mfw is not None:
+            if pmask.any():
+                handle.pending = admit_scan_preempt(
+                    *args, pmask, np.maximum(cls.preempt_slot0, 0),
+                    targets.tgt_mat, targets.tu_cq, targets.tu_delta,
+                    order, depth=st.depth)
+            elif mfw is not None:
                 handle.pending = admit_scan_forests(
-                    *args, st.forest_of_node, depth=st.depth,
+                    *args, order, st.forest_of_node, depth=st.depth,
                     n_forests=st.n_forests, max_forest_wl=mfw)
             else:
-                handle.pending = admit_scan(*args, depth=st.depth)
+                handle.pending = admit_scan(*args, order, depth=st.depth)
         return handle
 
     def fetch(self, handle: DispatchHandle) -> DeviceCycleFinal:
         """Block for an in-flight scan's decisions (head order)."""
         if handle.admitted is None:
             import jax
-            handle.admitted = np.asarray(jax.device_get(handle.pending))
+            out = jax.device_get(handle.pending)
             handle.pending = None
+            if isinstance(out, tuple):
+                handle.admitted = np.asarray(out[0])
+                handle.preempting = np.asarray(out[1])
+                handle.overlap_skip = np.asarray(out[2])
+            else:
+                W = len(handle.rmask)
+                handle.admitted = np.asarray(out)
+                handle.preempting = np.zeros(W, dtype=bool)
+                handle.overlap_skip = np.zeros(W, dtype=bool)
         n = handle.n
         return DeviceCycleFinal(
             order=handle.order[handle.order < n],
-            admitted=handle.admitted[:n], reserve_mask=handle.rmask[:n])
+            admitted=handle.admitted[:n], reserve_mask=handle.rmask[:n],
+            preempting=handle.preempting[:n],
+            overlap_skip=handle.overlap_skip[:n])
 
     def solve_full(self, cls: ClassifiedCycle,
                    reserve_mask: np.ndarray) -> DeviceCycleFinal:
@@ -503,7 +634,8 @@ class CycleSolver:
         return self._build_assignment(cls, wi, slot, Mode.FIT, borrow)
 
     def _build_assignment(self, cls: ClassifiedCycle, wi: int, slot: int,
-                          mode: Mode, borrow: bool) -> Assignment:
+                          mode: Mode, borrow: bool,
+                          res_modes: Optional[dict] = None) -> Assignment:
         h = cls.heads[wi]
         snapshot = cls.snapshot
         cq = snapshot.cq(h.cluster_queue)
@@ -529,8 +661,10 @@ class CycleSolver:
                 name=psr.name, requests=Requests(reqs), count=psr.count)
             flavor_idx: dict[str, int] = {}
             for res in reqs:
+                res_mode = mode if res_modes is None else res_modes.get(
+                    res, mode)
                 ps_res.flavors[res] = FlavorAssignmentDecision(
-                    name=flavor_name, mode=mode, borrow=borrow,
+                    name=flavor_name, mode=res_mode, borrow=borrow,
                     tried_flavor_idx=tried)
                 flavor_idx[res] = tried
                 fr = FlavorResource(flavor_name, res)
@@ -540,16 +674,30 @@ class CycleSolver:
             assignment.last_state.last_tried_flavor_idx.append(flavor_idx)
         return assignment
 
+    def build_preempt_assignment(self, cls: ClassifiedCycle,
+                                 wi: int) -> Assignment:
+        """Host Assignment for a preempt-classified head with per-resource
+        modes (resources fitting on the preempt slot are FIT, the
+        shortfall resources PREEMPT — flavorassigner.go:692), as the
+        preemptor's target search expects (preemption.go:466)."""
+        slot = int(cls.preempt_slot0[wi])
+        borrow = bool(cls.preempt_borrows0[wi])
+        st = cls.packed.structure
+        res_modes = {res: (Mode.FIT if cls.preempt_res_fit[wi][ri]
+                           else Mode.PREEMPT)
+                     for res, ri in st.r_index.items()}
+        return self._build_assignment(cls, wi, slot, Mode.PREEMPT, borrow,
+                                      res_modes=res_modes)
+
     def reserve_details(self, cls: ClassifiedCycle, wi: int
                         ) -> tuple[Assignment, str]:
         """Assignment + inadmissible message for a preempt-classified head
-        with no candidates (single-flavor CQs only), replicating the host
-        walk's reasons (flavorassigner.go:692 messages)."""
+        with no candidates (reachable whenever exactly one slot is
+        preempt-capable, including multi-flavor CQs whose other slots are
+        NoFit), replicating the host walk's reasons (flavorassigner.go:692
+        messages)."""
         h = cls.heads[wi]
-        slot = int(cls.preempt_slot0[wi])
-        borrow = bool(cls.preempt_borrows0[wi])
-        assignment = self._build_assignment(cls, wi, slot, Mode.PREEMPT,
-                                            borrow)
+        assignment = self.build_preempt_assignment(cls, wi)
         cq = cls.snapshot.cq(h.cluster_queue)
         ps = assignment.pod_sets[0]
         reasons = []
@@ -590,11 +738,6 @@ class CycleSolver:
                 if ri is not None and not res_fit[ri]:
                     frs_need.add(fr)
         return frs_need, usage
-
-    def slot_count(self, cls: ClassifiedCycle, wi: int) -> int:
-        st = cls.packed.structure
-        ci = st.cq_index.get(cls.heads[wi].cluster_queue, -1)
-        return int(st.slot_count_cq[ci]) if ci >= 0 else 0
 
     # -- back-compat one-shot API (tests/probes) -----------------------
 

@@ -115,10 +115,28 @@ class Preemptor:
         # Pluggable apply hook (reference OverrideApply, preemption.go:96):
         # called with (target Info, reason, message) when issuing evictions.
         self.apply_preemption: Optional[Callable[[Info, str, str], None]] = None
-        # Run the minimal-preemptions search on device (falls back to the
-        # host greedy+fillback when the scenario is unsupported).
-        self.device_search = False
+        # Run the minimal-preemptions search on device.  "auto" (default):
+        # device whenever the scheduler threaded the cycle's cached pack
+        # for the current snapshot (O(candidates) per search, no re-pack);
+        # True: always (re-packs the snapshot when no pack is cached);
+        # False: host greedy+fillback only.  All three are
+        # decision-identical (tests/test_preemption_kernel.py).
+        self.device_search: object = "auto"
+        self._cycle_pack = None   # (weakref to snapshot, PackedCycle)
         self.stats = {"device_searches": 0, "host_searches": 0}
+
+    def set_cycle_pack(self, snapshot: Snapshot, packed) -> None:
+        """Thread the admission solver's cached pack for this cycle's
+        snapshot so nominate-time searches skip the O(cluster) re-pack.
+        Only valid for searches against the same (unmutated) snapshot —
+        nominate runs before any admit-loop usage mutation."""
+        import weakref
+        self._cycle_pack = (weakref.ref(snapshot), packed)
+
+    def _pack_for(self, snapshot: Snapshot):
+        if self._cycle_pack is not None and self._cycle_pack[0]() is snapshot:
+            return self._cycle_pack[1]
+        return None
 
     # ------------------------------------------------------------------
     # Target selection — reference preemption.go:127-191
@@ -250,11 +268,13 @@ class Preemptor:
                              allow_borrowing: bool,
                              allow_borrowing_below_priority: Optional[int]
                              ) -> list[Target]:
-        if self.device_search:
+        packed = self._pack_for(ctx.snapshot)
+        if self.device_search is True or (
+                self.device_search == "auto" and packed is not None):
             from ..ops.preemption_solver import device_minimal_preemptions
             result = device_minimal_preemptions(
                 ctx, candidates, allow_borrowing,
-                allow_borrowing_below_priority)
+                allow_borrowing_below_priority, packed=packed)
             if result is not None:
                 self.stats["device_searches"] += 1
                 return result

@@ -271,14 +271,18 @@ class Scheduler:
         n = cls.n
         reserve = np.zeros(n, dtype=bool)
         full_ok = True
+        targets_by_wi: dict[int, list] = {}
+        assignments_by_wi: dict[int, Assignment] = {}
+        self.preemptor.set_cycle_pack(snapshot, cls.packed)
         for wi in np.nonzero(cls.preempt0[:n])[0]:
-            # Single-flavor CQs only: with several flavors the preempt
-            # best-slot choice depends on the reclaim oracle
+            wi = int(wi)
+            # Exactly one preempt-capable slot required: with several, the
+            # host walk's choice depends on the reclaim oracle
             # (flavorassigner.go:692 RECLAIM beats PREEMPT).
-            if solver.slot_count(cls, int(wi)) != 1:
+            if cls.preempt_slot_count[wi] != 1:
                 full_ok = False
                 break
-            frs_need, usage = solver.preemption_probe(cls, int(wi))
+            frs_need, usage = solver.preemption_probe(cls, wi)
             e = deferred[wi]
             from .preemption import _PreemptionCtx
             ctx = _PreemptionCtx(
@@ -287,10 +291,26 @@ class Scheduler:
                 snapshot=snapshot,
                 frs_need_preemption=frs_need,
                 workload_usage=usage)
-            if self.preemptor._find_candidates(ctx):
+            if not self.preemptor._find_candidates(ctx):
+                reserve[wi] = True
+                continue
+            # preempt head WITH candidates: run the real target search at
+            # nominate (device-backed minimalPreemptions) so the cycle
+            # stays fully device-decided (preemption.go:127-191)
+            assignment = solver.build_preempt_assignment(cls, wi)
+            targets = self.preemptor.get_targets(e.info, assignment,
+                                                 snapshot)
+            if targets:
+                targets_by_wi[wi] = targets
+                assignments_by_wi[wi] = assignment
+            else:
+                reserve[wi] = True
+
+        packed_targets = None
+        if full_ok and targets_by_wi:
+            packed_targets = solver.pack_targets(cls, targets_by_wi)
+            if packed_targets is None:
                 full_ok = False
-                break
-            reserve[wi] = True
 
         if not full_ok:
             solver.stats["classify_cycles"] += 1
@@ -305,9 +325,9 @@ class Scheduler:
                     self._assign_entry(e, snapshot)
             return None
 
-        handle = solver.dispatch(cls, reserve)
+        handle = solver.dispatch(cls, reserve, packed_targets)
         solver.stats["full_cycles"] += 1
-        return (deferred, cls, handle)
+        return (deferred, cls, handle, assignments_by_wi, targets_by_wi)
 
     def _admit_device_cycle(self, device, snapshot: Snapshot,
                             stats: CycleStats) -> None:
@@ -320,7 +340,7 @@ class Scheduler:
         reserve messages, NoFit walks, speculative admit objects) runs
         FIRST, overlapped with the device execution; ``solver.fetch`` then
         blocks only for whatever latency is left."""
-        deferred, cls, handle = device
+        deferred, cls, handle, assignments_by_wi, targets_by_wi = device
         solver = self.solver
         n = cls.n
         for wi in range(n):
@@ -329,6 +349,11 @@ class Scheduler:
                 e.assignment = solver.build_fit_assignment(cls, wi)
                 e.info.last_assignment = e.assignment.last_state
                 e.inadmissible_msg = ""
+            elif wi in assignments_by_wi:
+                e.assignment = assignments_by_wi[wi]
+                e.inadmissible_msg = e.assignment.message()
+                e.info.last_assignment = e.assignment.last_state
+                e.preemption_targets = targets_by_wi[wi]
             elif handle.rmask[wi]:
                 e.assignment, e.inadmissible_msg = solver.reserve_details(
                     cls, wi)
@@ -359,6 +384,28 @@ class Scheduler:
                     stats.admitted.append(e.info.key)
                 else:
                     e.inadmissible_msg = "Failed to admit workload"
+            elif final.preempting is not None and final.preempting[wi]:
+                # in-scan preemption winner: issue the evictions
+                # (scheduler.go:176-284 preempt branch)
+                e.info.last_assignment = None
+                preempted = self.preemptor.issue_preemptions(
+                    e.info, e.preemption_targets)
+                if preempted:
+                    e.inadmissible_msg += (f". Pending the preemption of "
+                                           f"{preempted} workload(s)")
+                    e.requeue_reason = RequeueReason.PENDING_PREEMPTION
+                stats.preempting.append(e.info.key)
+                stats.preempted_targets.extend(
+                    t.info.key for t in e.preemption_targets)
+            elif final.overlap_skip is not None and final.overlap_skip[wi]:
+                self._set_skipped(e, "Workload has overlapping preemption "
+                                     "targets with another workload")
+                if self.metrics is not None:
+                    self.metrics.cycle_preemption_skip()
+            elif wi in assignments_by_wi:
+                # preempt entry that no longer fits after earlier entries
+                self._set_skipped(e, "Workload no longer fits after "
+                                     "processing another workload")
             elif cls.fit_slot0[wi] >= 0:
                 # fit at nominate, lost capacity in-scan (scheduler.go:245)
                 self._set_skipped(e, "Workload no longer fits after "

@@ -122,6 +122,114 @@ def test_per_cycle_parity_host_vs_device(seed):
     assert stats["host_cycles"] == 0, stats
 
 
+def build_preemption_heavy(seed, use_device, n_cohorts=3, cqs_per_cohort=3,
+                           n_wl=90):
+    """Tight quotas + strong priority split + staggered arrival: later
+    high-priority workloads must preempt admitted low-priority ones, so
+    cycles carry preempt heads WITH candidates (the in-scan preemption
+    path), overlapping-target races, and reclaim across borrowing CQs."""
+    rng = random.Random(seed)
+    clock = FakeClock()
+    d = Driver(clock=clock, use_device_solver=use_device,
+               solver_backend="cpu" if use_device else "auto")
+    d.apply_resource_flavor(ResourceFlavor(name="default"))
+    pre = PreemptionPolicy(
+        reclaim_within_cohort=ReclaimWithinCohort.ANY,
+        within_cluster_queue=WithinClusterQueue.LOWER_PRIORITY)
+    for c in range(n_cohorts):
+        for q in range(cqs_per_cohort):
+            name = f"cq-{c}-{q}"
+            d.apply_cluster_queue(ClusterQueue(
+                name=name, cohort=f"cohort-{c}", preemption=pre,
+                resource_groups=[ResourceGroup(
+                    covered_resources=["cpu"],
+                    flavors=[FlavorQuotas(name="default", resources={
+                        "cpu": ResourceQuota(nominal=3000,
+                                             borrowing_limit=6000)})])]))
+            d.apply_local_queue(LocalQueue(name=f"lq-{c}-{q}",
+                                           cluster_queue=name))
+    low, high = [], []
+    for i in range(n_wl):
+        c = rng.randrange(n_cohorts)
+        q = rng.randrange(cqs_per_cohort)
+        is_high = i % 3 == 2
+        wl = Workload(
+            name=f"wl-{i}", queue_name=f"lq-{c}-{q}",
+            priority=100 if is_high else rng.choice([5, 10]),
+            creation_time=float(i + 1),
+            pod_sets=[PodSet(name="main", count=1,
+                             requests={"cpu": rng.choice(
+                                 [1000, 2000, 3000])})])
+        (high if is_high else low).append(wl)
+    return d, clock, low, high
+
+
+def drive_two_phase(d, clock, low, high, n_cycles=40, runtime=4):
+    """Admit the low-priority wave first, then inject the high wave so
+    preemption searches run against real admitted candidates."""
+    for wl in low:
+        d.create_workload(wl)
+    log = []
+    running = []
+
+    def one_cycle(cycle):
+        clock.t += 1.0
+        stats = d.schedule_once()
+        admissions = []
+        for key in stats.admitted:
+            wl = d.workload(key)
+            flavors = tuple(sorted(
+                (a.name, a.count, tuple(sorted(a.flavors.items())))
+                for a in wl.admission.pod_set_assignments))
+            admissions.append((key, flavors))
+            running.append((cycle + runtime, key))
+        log.append({
+            "admitted": admissions,
+            "skipped": sorted(stats.skipped),
+            "inadmissible": sorted(stats.inadmissible),
+            "preempting": sorted(stats.preempting),
+            "targets": sorted(stats.preempted_targets),
+        })
+        still = []
+        for fin, key in running:
+            wl = d.workload(key)
+            if wl is None or not wl.has_quota_reservation:
+                continue
+            if fin <= cycle:
+                d.finish_workload(key)
+            else:
+                still.append((fin, key))
+        running[:] = still
+
+    for cycle in range(6):
+        one_cycle(cycle)
+    for wl in high:
+        d.create_workload(wl)
+    for cycle in range(6, n_cycles):
+        one_cycle(cycle)
+    return log
+
+
+@pytest.mark.parametrize("seed", [21, 22, 23, 24, 25])
+def test_preemption_cycle_parity_host_vs_device(seed):
+    host, hclock, hlow, hhigh = build_preemption_heavy(seed, use_device=False)
+    dev, dclock, dlow, dhigh = build_preemption_heavy(seed, use_device=True)
+    hlog = drive_two_phase(host, hclock, hlow, hhigh)
+    dlog = drive_two_phase(dev, dclock, dlow, dhigh)
+    preempted_any = any(cyc["preempting"] for cyc in hlog)
+    assert preempted_any, f"seed {seed}: scenario produced no preemptions"
+    for cyc, (h, dv) in enumerate(zip(hlog, dlog)):
+        assert h == dv, (
+            f"seed {seed} cycle {cyc} diverged:\nhost={h}\ndevice={dv}\n"
+            f"stats={dev.scheduler.solver.stats}")
+    stats = dev.scheduler.solver.stats
+    assert stats["host_cycles"] == 0, stats
+    # the device path must have decided preemption cycles in-scan, with
+    # targets found by the device preemption search
+    assert dev.scheduler.preemptor.stats["device_searches"] >= 1, \
+        dev.scheduler.preemptor.stats
+
+
 def test_reserve_path_runs_on_device():
     """Equal-priority contention: the pending head classifies
     preempt-capable with zero candidates → the device cycle reserves

@@ -157,7 +157,10 @@ def test_end_to_end_parity_host_vs_device(seed):
         d.scheduler.solver.stats
 
 
-def test_device_solver_used_and_falls_back():
+def test_device_solver_preempts_in_full_mode():
+    """A preempt head with candidates stays fully device-decided: targets
+    come from the device preemption search at nominate and the preempting
+    entry is decided inside the admit scan."""
     from kueue_tpu.api.types import PreemptionPolicy, WithinClusterQueue
     clock = FakeClock()
     d = Driver(clock=clock, use_device_solver=True)
@@ -176,16 +179,19 @@ def test_device_solver_used_and_falls_back():
                                                 requests={"cpu": 2000})]))
     d.run_until_settled()
     assert (d.scheduler.solver.stats["full_cycles"] + d.scheduler.solver.stats["classify_cycles"]) >= 1
-    # higher-priority arrival requires preemption -> host fallback
+    # higher-priority arrival preempts the low one, all on device
     d.create_workload(Workload(name="high", queue_name="lq", priority=100,
                                creation_time=2.0,
                                pod_sets=[PodSet(name="main", count=1,
                                                 requests={"cpu": 2000})]))
     d.run_until_settled()
-    # a preempt head with candidates drops the cycle to classify mode:
-    # device nominate + host admit loop
-    assert d.scheduler.solver.stats["classify_cycles"] >= 1
+    assert d.scheduler.solver.stats["host_cycles"] == 0, \
+        d.scheduler.solver.stats
+    assert d.scheduler.preemptor.stats["device_searches"] >= 1, \
+        d.scheduler.preemptor.stats
     assert d.admitted_keys() == {"default/high"}
+    low = d.workload("default/low")
+    assert low.is_evicted
 
 
 def test_device_solver_charges_pods_quota():
