@@ -150,37 +150,42 @@ def cycle_order_np(borrows, priority, timestamp) -> np.ndarray:
 # Device admit scan (fixed assignments; the production phase 2)
 # ----------------------------------------------------------------------
 
-def _entry_decision(avail, usage, wi, valid, *, slot_fr, nominal_cq, npb_cq,
-                    wl_cq, wl_requests, decision_slot, reserve_mask,
-                    reserve_slot, reserve_borrows):
+def _entry_decision(avail, usage, wi, valid, *, nominal_cq, npb_cq, wl_cq,
+                    dec_fr, dec_amt, fit_mask, res_fr, res_amt, res_mask,
+                    res_borrows):
     """The per-entry decision shared by admit_scan and admit_scan_forests:
-    fixed-slot fit re-check (scheduler.go:372) or capacity reserve
-    (resourcesToReserve, scheduler.go:383-408).
+    fixed-assignment fit re-check (scheduler.go:372, Fits over
+    assignment.Usage) or capacity reserve (resourcesToReserve,
+    scheduler.go:383-408).
+
+    Decisions are (flavor-resource, amount) pairs [K] per head — exactly
+    the assignment.Usage map the reference re-checks — so multi-resource-
+    group and multi-PodSet assignments need no special casing here.  The
+    packer guarantees each head's pairs have distinct flavor-resources.
 
     Returns (admit, node, delta_f): node is the CQ to charge (-1 = no-op)."""
     wis = jnp.maximum(wi, 0)
     cq = jnp.maximum(wl_cq[wis], 0)
-    req = wl_requests[wis]
     F = usage.shape[1]
 
-    slot = decision_slot[wis]
-    is_fit = (slot >= 0) & valid
-    frs = slot_fr[cq, jnp.maximum(slot, 0)]                 # [R]
+    frs = dec_fr[wis]                                       # [K]
+    amt = dec_amt[wis]
     frs_safe = jnp.maximum(frs, 0)
-    relevant = (frs >= 0) & (req > 0)
-    ok = jnp.all(jnp.where(relevant, req <= avail[cq][frs_safe], True))
-    admit = is_fit & ok
+    relevant = frs >= 0
+    ok = jnp.all(jnp.where(relevant, amt <= avail[cq][frs_safe], True))
+    admit = fit_mask[wis] & valid & ok
     delta_f = jnp.zeros(F, dtype=usage.dtype).at[frs_safe].add(
-        jnp.where(relevant & admit, req, 0))
+        jnp.where(relevant & admit, amt, 0))
 
-    is_res = reserve_mask[wis] & valid
-    rfrs = slot_fr[cq, jnp.maximum(reserve_slot[wis], 0)]
+    is_res = res_mask[wis] & valid
+    rfrs = res_fr[wis]
+    ramt = res_amt[wis]
     rfrs_safe = jnp.maximum(rfrs, 0)
-    rrel = (rfrs >= 0) & (req > 0)
+    rrel = rfrs >= 0
     cur = usage[cq][rfrs_safe]
-    res_borrow = jnp.minimum(req, npb_cq[cq][rfrs_safe] - cur)
-    res_nob = jnp.maximum(0, jnp.minimum(req, nominal_cq[cq][rfrs_safe] - cur))
-    rdelta = jnp.where(reserve_borrows[wis], res_borrow, res_nob)
+    res_borrow = jnp.minimum(ramt, npb_cq[cq][rfrs_safe] - cur)
+    res_nob = jnp.maximum(0, jnp.minimum(ramt, nominal_cq[cq][rfrs_safe] - cur))
+    rdelta = jnp.where(res_borrows[wis], res_borrow, res_nob)
     delta_f = delta_f.at[rfrs_safe].add(
         jnp.where(rrel & is_res, rdelta, 0))
 
@@ -189,26 +194,25 @@ def _entry_decision(avail, usage, wi, valid, *, slot_fr, nominal_cq, npb_cq,
 
 
 def _admit_step(usage, wi, *, subtree, guaranteed, borrow_cap, has_blim,
-                parent, slot_fr, nominal_cq, npb_cq, wl_cq, wl_requests,
-                decision_slot, reserve_mask, reserve_slot, reserve_borrows,
-                depth):
+                parent, nominal_cq, npb_cq, wl_cq, dec_fr, dec_amt,
+                fit_mask, res_fr, res_amt, res_mask, res_borrows, depth):
     """One cycle-order step: fit re-check + admit, or capacity reserve."""
     avail = available_all(usage, subtree, guaranteed, borrow_cap,
                           has_blim, parent, depth)
     admit, node, delta_f = _entry_decision(
-        avail, usage, wi, wl_cq[wi] >= 0, slot_fr=slot_fr,
+        avail, usage, wi, wl_cq[wi] >= 0,
         nominal_cq=nominal_cq, npb_cq=npb_cq, wl_cq=wl_cq,
-        wl_requests=wl_requests, decision_slot=decision_slot,
-        reserve_mask=reserve_mask, reserve_slot=reserve_slot,
-        reserve_borrows=reserve_borrows)
+        dec_fr=dec_fr, dec_amt=dec_amt, fit_mask=fit_mask,
+        res_fr=res_fr, res_amt=res_amt, res_mask=res_mask,
+        res_borrows=res_borrows)
     usage = add_usage_chain(usage, node, delta_f, guaranteed, parent, depth)
     return usage, admit
 
 
 @partial(jax.jit, static_argnames=("depth",))
 def admit_scan(usage0, subtree, guaranteed, borrow_cap, has_blim, parent,
-               slot_fr, nominal_cq, npb_cq, wl_cq, wl_requests,
-               decision_slot, reserve_mask, reserve_slot, reserve_borrows,
+               nominal_cq, npb_cq, wl_cq, dec_fr, dec_amt, fit_mask,
+               res_fr, res_amt, res_mask, res_borrows,
                order, *, depth: int):
     """The sequential admit loop over ``order`` as one lax.scan.
 
@@ -218,11 +222,10 @@ def admit_scan(usage0, subtree, guaranteed, borrow_cap, has_blim, parent,
     W = wl_cq.shape[0]
     step = partial(_admit_step, subtree=subtree, guaranteed=guaranteed,
                    borrow_cap=borrow_cap, has_blim=has_blim, parent=parent,
-                   slot_fr=slot_fr, nominal_cq=nominal_cq, npb_cq=npb_cq,
-                   wl_cq=wl_cq, wl_requests=wl_requests,
-                   decision_slot=decision_slot, reserve_mask=reserve_mask,
-                   reserve_slot=reserve_slot,
-                   reserve_borrows=reserve_borrows, depth=depth)
+                   nominal_cq=nominal_cq, npb_cq=npb_cq, wl_cq=wl_cq,
+                   dec_fr=dec_fr, dec_amt=dec_amt, fit_mask=fit_mask,
+                   res_fr=res_fr, res_amt=res_amt, res_mask=res_mask,
+                   res_borrows=res_borrows, depth=depth)
     _, admit_o = jax.lax.scan(step, usage0, order)
     return jnp.zeros(W, dtype=bool).at[order].set(admit_o)
 
@@ -251,12 +254,11 @@ def _remove_usage_chain(usage, node, delta, guaranteed, parent, depth):
 
 
 def _preempt_entry_decision(avail_check, usage, usage_check, used, wi, valid,
-                            *, slot_fr, nominal_cq, npb_cq, wl_cq,
-                            wl_requests, decision_slot, reserve_mask,
-                            reserve_slot, reserve_borrows, preempt_mask,
-                            preempt_slot, tgt_mat, tu_cq, tu_delta,
-                            guaranteed, parent, subtree, borrow_cap,
-                            has_blim, depth):
+                            *, nominal_cq, npb_cq, wl_cq, dec_fr, dec_amt,
+                            fit_mask, res_fr, res_amt, res_mask,
+                            res_borrows, preempt_mask, pre_fr, pre_amt,
+                            tgt_mat, tu_cq, tu_delta, guaranteed, parent,
+                            subtree, borrow_cap, has_blim, depth):
     """One entry of the preemption-aware admit loop.
 
     Mirrors the reference admit loop (scheduler.go:211-284) with
@@ -272,21 +274,19 @@ def _preempt_entry_decision(avail_check, usage, usage_check, used, wi, valid,
     caller only when the entry preempts)."""
     wis = jnp.maximum(wi, 0)
     cq = jnp.maximum(wl_cq[wis], 0)
-    req = wl_requests[wis]
     F = usage.shape[1]
     MT = tgt_mat.shape[1]
 
-    # --- fit entry: re-check the fixed slot against avail_check ---
-    slot = decision_slot[wis]
-    is_fit = (slot >= 0) & valid
-    frs = slot_fr[cq, jnp.maximum(slot, 0)]
+    # --- fit entry: re-check the fixed pairs against avail_check ---
+    frs = dec_fr[wis]
+    amt = dec_amt[wis]
     frs_safe = jnp.maximum(frs, 0)
-    relevant = (frs >= 0) & (req > 0)
-    fit_ok = jnp.all(jnp.where(relevant, req <= avail_check[cq][frs_safe],
+    relevant = frs >= 0
+    fit_ok = jnp.all(jnp.where(relevant, amt <= avail_check[cq][frs_safe],
                                True))
-    admit = is_fit & fit_ok
+    admit = fit_mask[wis] & valid & fit_ok
     delta_f = jnp.zeros(F, dtype=usage.dtype).at[frs_safe].add(
-        jnp.where(relevant & admit, req, 0))
+        jnp.where(relevant & admit, amt, 0))
 
     # --- preempt entry: overlap check + remove targets + fits ---
     is_pre = preempt_mask[wis] & valid
@@ -306,27 +306,29 @@ def _preempt_entry_decision(avail_check, usage, usage_check, used, wi, valid,
     u_try = jax.lax.fori_loop(0, MT, rm, usage_check)
     avail_try = available_all(u_try, subtree, guaranteed, borrow_cap,
                               has_blim, parent, depth)
-    pfrs = slot_fr[cq, jnp.maximum(preempt_slot[wis], 0)]
+    pfrs = pre_fr[wis]
+    pamt = pre_amt[wis]
     pfrs_safe = jnp.maximum(pfrs, 0)
-    p_rel = (pfrs >= 0) & (req > 0)
-    pre_ok = jnp.all(jnp.where(p_rel, req <= avail_try[cq][pfrs_safe], True))
+    p_rel = pfrs >= 0
+    pre_ok = jnp.all(jnp.where(p_rel, pamt <= avail_try[cq][pfrs_safe], True))
     preempting = act_pre & pre_ok
     pre_delta = jnp.zeros(F, dtype=usage.dtype).at[pfrs_safe].add(
-        jnp.where(p_rel & preempting, req, 0))
+        jnp.where(p_rel & preempting, pamt, 0))
     delta_f = delta_f + pre_delta
     # max-scatter: pads share index 0 with real targets; a duplicate
     # .set's winner is undefined, while max(used, mark) is order-free
     used_next = used.at[t_safe].max(t_valid & preempting)
 
     # --- reserve entry (unchanged semantics) ---
-    is_res = reserve_mask[wis] & valid
-    rfrs = slot_fr[cq, jnp.maximum(reserve_slot[wis], 0)]
+    is_res = res_mask[wis] & valid
+    rfrs = res_fr[wis]
+    ramt = res_amt[wis]
     rfrs_safe = jnp.maximum(rfrs, 0)
-    rrel = (rfrs >= 0) & (req > 0)
+    rrel = rfrs >= 0
     cur = usage[cq][rfrs_safe]
-    res_borrow = jnp.minimum(req, npb_cq[cq][rfrs_safe] - cur)
-    res_nob = jnp.maximum(0, jnp.minimum(req, nominal_cq[cq][rfrs_safe] - cur))
-    rdelta = jnp.where(reserve_borrows[wis], res_borrow, res_nob)
+    res_borrow = jnp.minimum(ramt, npb_cq[cq][rfrs_safe] - cur)
+    res_nob = jnp.maximum(0, jnp.minimum(ramt, nominal_cq[cq][rfrs_safe] - cur))
+    rdelta = jnp.where(res_borrows[wis], res_borrow, res_nob)
     delta_f = delta_f.at[rfrs_safe].add(jnp.where(rrel & is_res, rdelta, 0))
 
     node = jnp.where(admit | preempting | is_res, wl_cq[wis], -1)
@@ -335,11 +337,10 @@ def _preempt_entry_decision(avail_check, usage, usage_check, used, wi, valid,
 
 @partial(jax.jit, static_argnames=("depth",))
 def admit_scan_preempt(usage0, subtree, guaranteed, borrow_cap, has_blim,
-                       parent, slot_fr, nominal_cq, npb_cq, wl_cq,
-                       wl_requests, decision_slot, reserve_mask,
-                       reserve_slot, reserve_borrows, preempt_mask,
-                       preempt_slot, tgt_mat, tu_cq, tu_delta, order,
-                       *, depth: int):
+                       parent, nominal_cq, npb_cq, wl_cq, dec_fr, dec_amt,
+                       fit_mask, res_fr, res_amt, res_mask, res_borrows,
+                       preempt_mask, pre_fr, pre_amt, tgt_mat, tu_cq,
+                       tu_delta, order, *, depth: int):
     """``admit_scan`` extended with preempting entries.
 
     Carries (usage, usage_check, used): ``usage`` follows the reference's
@@ -359,11 +360,11 @@ def admit_scan_preempt(usage0, subtree, guaranteed, borrow_cap, has_blim,
         admit, preempting, overlap_skip, node, delta_f, u_try, used = (
             _preempt_entry_decision(
                 avail_check, usage, usage_check, used, wi, wl_cq[wi] >= 0,
-                slot_fr=slot_fr, nominal_cq=nominal_cq, npb_cq=npb_cq,
-                wl_cq=wl_cq, wl_requests=wl_requests,
-                decision_slot=decision_slot, reserve_mask=reserve_mask,
-                reserve_slot=reserve_slot, reserve_borrows=reserve_borrows,
-                preempt_mask=preempt_mask, preempt_slot=preempt_slot,
+                nominal_cq=nominal_cq, npb_cq=npb_cq, wl_cq=wl_cq,
+                dec_fr=dec_fr, dec_amt=dec_amt, fit_mask=fit_mask,
+                res_fr=res_fr, res_amt=res_amt, res_mask=res_mask,
+                res_borrows=res_borrows, preempt_mask=preempt_mask,
+                pre_fr=pre_fr, pre_amt=pre_amt,
                 tgt_mat=tgt_mat, tu_cq=tu_cq, tu_delta=tu_delta,
                 guaranteed=guaranteed, parent=parent, subtree=subtree,
                 borrow_cap=borrow_cap, has_blim=has_blim, depth=depth))
@@ -453,14 +454,33 @@ def solve_cycle(usage0, subtree, guaranteed, borrow_cap, has_blim, parent,
     order = jnp.lexsort((jnp.arange(W), wl_timestamp, -wl_priority,
                          borrows0.astype(jnp.int32)))
     no_reserve = jnp.zeros(W, dtype=bool)
+    dec_fr, dec_amt, fit_mask = decision_pairs_from_slots(
+        slot_fr, wl_cq, wl_requests, fit_slot0)
+    zero_pairs = jnp.full_like(dec_fr, -1)
     admitted = admit_scan(
-        usage0, subtree, guaranteed, borrow_cap, has_blim, parent, slot_fr,
-        nominal_cq, jnp.zeros_like(nominal_cq), wl_cq, wl_requests,
-        fit_slot0, no_reserve, jnp.zeros(W, dtype=jnp.int32), no_reserve,
-        order, depth=depth)
+        usage0, subtree, guaranteed, borrow_cap, has_blim, parent,
+        nominal_cq, jnp.zeros_like(nominal_cq), wl_cq, dec_fr, dec_amt,
+        fit_mask, zero_pairs, jnp.zeros_like(dec_amt), no_reserve,
+        no_reserve, order, depth=depth)
     slots = jnp.where(admitted, fit_slot0, -1).astype(jnp.int32)
     borrows = borrows0 & admitted
     return admitted, slots, borrows, preempt0, fit_slot0, borrows0
+
+
+def decision_pairs_from_slots(slot_fr, wl_cq, wl_requests, fit_slot0):
+    """Single-slot classifications → decision pairs (jax or numpy).
+
+    dec_fr/dec_amt [W, R]: the chosen slot's flavor-resource per requested
+    resource (-1 where not requested or not fit); fit_mask [W]."""
+    xp = jnp if isinstance(wl_cq, jnp.ndarray) else np
+    cqs = xp.maximum(wl_cq, 0)
+    slots = xp.maximum(fit_slot0, 0)
+    frs = slot_fr[cqs, slots]                               # [W, R]
+    fit_mask = (fit_slot0 >= 0) & (wl_cq >= 0)
+    relevant = (frs >= 0) & (wl_requests > 0) & fit_mask[:, None]
+    dec_fr = xp.where(relevant, frs, -1).astype(xp.int32)
+    dec_amt = xp.where(relevant, wl_requests, 0).astype(xp.int32)
+    return dec_fr, dec_amt, fit_mask
 
 
 def add_usage_chain_batched(usage, nodes, deltas, guaranteed, parent,
@@ -505,9 +525,9 @@ def _forest_schedule(order, f_w, W, G, max_forest_wl):
 
 @partial(jax.jit, static_argnames=("depth", "n_forests", "max_forest_wl"))
 def admit_scan_forests(usage0, subtree, guaranteed, borrow_cap, has_blim,
-                       parent, slot_fr, nominal_cq, npb_cq, wl_cq,
-                       wl_requests, decision_slot, reserve_mask,
-                       reserve_slot, reserve_borrows, order, forest_of_node,
+                       parent, nominal_cq, npb_cq, wl_cq, dec_fr, dec_amt,
+                       fit_mask, res_fr, res_amt, res_mask, res_borrows,
+                       order, forest_of_node,
                        *, depth: int, n_forests: int, max_forest_wl: int):
     """``admit_scan`` parallelized over independent cohort forests.
 
@@ -532,11 +552,10 @@ def admit_scan_forests(usage0, subtree, guaranteed, borrow_cap, has_blim,
             lambda wi: _entry_decision(
                 avail, usage, wi,
                 (wi >= 0) & (wl_cq[jnp.maximum(wi, 0)] >= 0),
-                slot_fr=slot_fr, nominal_cq=nominal_cq, npb_cq=npb_cq,
-                wl_cq=wl_cq, wl_requests=wl_requests,
-                decision_slot=decision_slot, reserve_mask=reserve_mask,
-                reserve_slot=reserve_slot,
-                reserve_borrows=reserve_borrows))(wis)
+                nominal_cq=nominal_cq, npb_cq=npb_cq,
+                wl_cq=wl_cq, dec_fr=dec_fr, dec_amt=dec_amt,
+                fit_mask=fit_mask, res_fr=res_fr, res_amt=res_amt,
+                res_mask=res_mask, res_borrows=res_borrows))(wis)
         usage = add_usage_chain_batched(usage, nodes, deltas, guaranteed,
                                         parent, depth)
         return usage, (wis, admit)
@@ -568,12 +587,14 @@ def solve_cycle_forests(usage0, subtree, guaranteed, borrow_cap, has_blim,
     order = jnp.lexsort((jnp.arange(W), wl_timestamp, -wl_priority,
                          borrows0.astype(jnp.int32))).astype(jnp.int32)
     no_reserve = jnp.zeros(W, dtype=bool)
+    dec_fr, dec_amt, fit_mask = decision_pairs_from_slots(
+        slot_fr, wl_cq, wl_requests, fit_slot0)
     admitted = admit_scan_forests(
-        usage0, subtree, guaranteed, borrow_cap, has_blim, parent, slot_fr,
-        nominal_cq, jnp.zeros_like(nominal_cq), wl_cq, wl_requests,
-        fit_slot0, no_reserve, jnp.zeros(W, dtype=jnp.int32), no_reserve,
-        order, forest_of_node, depth=depth, n_forests=n_forests,
-        max_forest_wl=max_forest_wl)
+        usage0, subtree, guaranteed, borrow_cap, has_blim, parent,
+        nominal_cq, jnp.zeros_like(nominal_cq), wl_cq, dec_fr, dec_amt,
+        fit_mask, jnp.full_like(dec_fr, -1), jnp.zeros_like(dec_amt),
+        no_reserve, no_reserve, order, forest_of_node, depth=depth,
+        n_forests=n_forests, max_forest_wl=max_forest_wl)
     slots = jnp.where(admitted, fit_slot0, -1).astype(jnp.int32)
     borrows = borrows0 & admitted
     return admitted, slots, borrows, preempt0, fit_slot0, borrows0

@@ -5,18 +5,28 @@ Per cycle the solver:
 1. packs (snapshot, heads) against a CACHED ``PackedStructure`` — the
    static cluster tensors are rebuilt only when the cache structure
    generation changes, so the per-cycle cost is O(usage + heads);
-2. runs the vectorized nominate (``ops.cycle.classify_np``) on the host —
-   no device round-trip for phase 1;
+2. runs the vectorized nominate (``ops.cycle.classify_np``) on the host
+   for heads whose shape the batched math covers (single resource group,
+   single PodSet, plain flavors, default fungibility); the remaining
+   heads are marked SCALAR — the scheduler runs the real host
+   FlavorAssigner walk for those few and attaches the resulting
+   assignment, so multi-resource-group CQs, multi-PodSet workloads,
+   taints/affinity, fungibility policies, resume state, partial
+   admission, and TAS all stay inside a device-decided cycle;
 3. dispatches the sequential admit scan (``ops.cycle.admit_scan``) as ONE
    jitted program, routed to the accelerator for large cycles and to the
    XLA CPU backend for small ones (a tunneled-TPU round trip costs ~100 ms
    flat, so small cycles can't amortize it — the kernel is identical on
-   both backends).
+   both backends).  The scan consumes per-head (flavor-resource, amount)
+   decision pairs — the assignment.Usage map the reference admit loop
+   re-checks (scheduler.go:372) — so HOW a head was classified (vector or
+   scalar) is invisible to the kernel.
 
-Falls back (returns None) when the cycle needs semantics not yet on
-device: TAS requests, fair sharing, non-default fungibility,
-multi-resource-group CQs, taints/affinity, or inexact int32 scaling — the
-host path then runs, keeping decisions bit-identical.
+Falls back (returns None) for fair-sharing cycles (tournament ordering),
+inexact int32 scaling, unrepresentable packs (a flavor-resource or node
+unknown to the cached structure after one rebuild), and scalar
+assignments whose usage can't be encoded exactly — the host path then
+runs, keeping decisions bit-identical.
 """
 
 from __future__ import annotations
@@ -41,7 +51,7 @@ from ..resources import FlavorResource, FlavorResourceQuantities, Requests
 from .packing import (PackedCycle, PackedStructure, _bucket, pack_cycle,
                       pack_structure)
 from .cycle import (admit_scan, admit_scan_forests, admit_scan_preempt,
-                    classify_np, cycle_order_np)
+                    classify_np, cycle_order_np, decision_pairs_from_slots)
 
 # A flat admit scan is one lax.scan step per head; the forest-parallel
 # variant processes one head per cohort forest per step.  Below this head
@@ -64,6 +74,11 @@ class ClassifiedCycle:
     preempt_borrows0: np.ndarray  # [W] bool
     preempt_res_fit: np.ndarray  # [W, R] bool
     preempt_slot_count: np.ndarray = None  # [W] int32 preempt-capable slots
+    # heads the vectorized math can't classify: the scheduler runs the
+    # host FlavorAssigner walk for these and attaches the assignment
+    scalar_mask: np.ndarray = None         # [W] bool
+    host_assignments: dict = None          # {wi: Assignment}
+    host_pairs: dict = None                # {wi: [(F-index, amount)]}
 
     @property
     def n(self) -> int:
@@ -101,6 +116,7 @@ class DispatchHandle:
     admitted: Optional[np.ndarray] = None  # resolved decisions [W]
     preempting: Optional[np.ndarray] = None
     overlap_skip: Optional[np.ndarray] = None
+    fit_mask: Optional[np.ndarray] = None  # [W] bool: vector + scalar fits
     route: str = ""              # "accel" | "cpu" | "no_fit" | "singleton"
 
 
@@ -137,6 +153,7 @@ class CycleSolver:
             "skipped_dispatches": 0,  # no fit head -> scan provably no-op
             "singleton_dispatches": 0,  # <=1 entry/forest -> no contention
             "structure_rebuilds": 0,
+            "scalar_heads": 0,        # heads classified by the host walk
         }
         self._structure: Optional[PackedStructure] = None
         self._potential0 = None
@@ -237,11 +254,13 @@ class CycleSolver:
         for W in buckets:
             args = (
                 np.zeros((N, F), np.int32), st.subtree_quota, st.guaranteed,
-                st.borrow_cap, st.has_borrow_limit, st.parent, st.slot_fr,
+                st.borrow_cap, st.has_borrow_limit, st.parent,
                 st.nominal_cq, st.nominal_plus_blimit_cq,
-                np.full(W, -1, np.int32), np.zeros((W, R), np.int32),
-                np.full(W, -1, np.int32), np.zeros(W, bool),
-                np.zeros(W, np.int32), np.zeros(W, bool),
+                np.full(W, -1, np.int32),
+                np.full((W, R), -1, np.int32), np.zeros((W, R), np.int32),
+                np.zeros(W, bool),
+                np.full((W, R), -1, np.int32), np.zeros((W, R), np.int32),
+                np.zeros(W, bool), np.zeros(W, bool),
                 np.arange(W, dtype=np.int32))
             devs = [self._cpu_dev]
             if (self._accel_dev is not None
@@ -283,10 +302,25 @@ class CycleSolver:
             # preemption-aware scan: warm + calibrate the common
             # small-target-universe buckets (T=8, MT=2); bigger universes
             # still compile on first use
+            # first padded-K bucket (scalar heads with more decision
+            # pairs than R, _build_pair_tensors): compile so a
+            # multi-PodSet head can't stall a cycle on compilation
+            Kpad = _bucket(R + 1, minimum=R if R >= 8 else 8)
+            kargs = (args[:9]
+                     + (np.full((W, Kpad), -1, np.int32),
+                        np.zeros((W, Kpad), np.int32), args[11],
+                        np.full((W, Kpad), -1, np.int32),
+                        np.zeros((W, Kpad), np.int32))
+                     + args[14:])
+            for dev in devs:
+                with jax.default_device(dev):
+                    jax.device_get(admit_scan(*kargs, depth=st.depth))
+
             T, MT = 8, 2
 
             pargs = args[:-1] + (
-                np.zeros(W, bool), np.zeros(W, np.int32),
+                np.zeros(W, bool),
+                np.full((W, R), -1, np.int32), np.zeros((W, R), np.int32),
                 np.full((W, MT), -1, np.int32), np.zeros(T, np.int32),
                 np.zeros((T, F), np.int32), args[-1])
             for dev in devs:
@@ -308,7 +342,7 @@ class CycleSolver:
         st = self._structure
         if st is None or st.generation != gen or gen < 0:
             st = pack_structure(snapshot, heads, generation=gen)
-            st.static_eligible = self._static_eligible(snapshot)
+            st.cq_vector_ok = self._cq_vector_ok(snapshot, st)
             self._structure = st
             self._potential0 = None
             self.stats["structure_rebuilds"] += 1
@@ -316,74 +350,89 @@ class CycleSolver:
 
     # -- eligibility ---------------------------------------------------
 
-    def _static_eligible(self, snapshot: Snapshot) -> bool:
-        """Spec-level support checks, cached with the structure."""
-        for name, cq in snapshot.cluster_queues.items():
-            if len(cq.spec.resource_groups) > 1:
-                return False
+    def _cq_vector_ok(self, snapshot: Snapshot,
+                      st: PackedStructure) -> np.ndarray:
+        """Per-CQ: can the vectorized classify reproduce the host flavor
+        walk for heads of this CQ?  Requires a single resource group,
+        default fungibility, and plain flavors (existing, no taints, no
+        node labels, no topology) — everything else routes the head to
+        the scalar host walk instead (flavorassigner.go:499-640)."""
+        ok = np.zeros(len(st.cq_names), dtype=bool)
+        for ci, name in enumerate(st.cq_names):
+            cq = snapshot.cluster_queues[name]
+            if len(cq.spec.resource_groups) != 1:
+                continue
             ff = cq.spec.flavor_fungibility
             if (ff.when_can_borrow != _DEFAULT_FF.when_can_borrow
                     or ff.when_can_preempt != _DEFAULT_FF.when_can_preempt):
-                return False
+                continue
+            plain = True
             for rg in cq.spec.resource_groups:
                 for fq in rg.flavors:
                     flavor = snapshot.resource_flavors.get(fq.name)
-                    if flavor is None:
-                        return False
-                    if flavor.node_taints or flavor.topology_name:
-                        return False
-        return True
+                    if (flavor is None or flavor.node_taints
+                            or flavor.node_labels or flavor.topology_name):
+                        plain = False
+                        break
+            ok[ci] = plain
+        return ok
 
-    def _heads_eligible(self, snapshot: Snapshot, heads: list[Info]) -> bool:
-        for h in heads:
-            if len(h.obj.pod_sets) > 1:
-                # the host can split flavors across pod sets; the device
-                # currently solves the summed request against one flavor
-                return False
+    def _scalar_mask(self, snapshot: Snapshot, heads: list[Info],
+                     st: PackedStructure) -> np.ndarray:
+        """Per-head: True → the head needs the scalar host walk (the
+        vectorized classify's assumptions don't hold)."""
+        mask = np.zeros(len(heads), dtype=bool)
+        cq_ok = st.cq_vector_ok
+        for wi, h in enumerate(heads):
+            ci = st.cq_index.get(h.cluster_queue, -1)
+            if ci < 0 or not cq_ok[ci]:
+                mask[wi] = True
+                continue
+            if len(h.obj.pod_sets) != 1:
+                # the host can split flavors across pod sets and accounts
+                # earlier pod sets' usage in later walks
+                mask[wi] = True
+                continue
+            ps = h.obj.pod_sets[0]
+            if ps.topology_request is not None:
+                mask[wi] = True
+                continue
             last = h.last_assignment
             if last is not None and last.pending_flavors:
-                # effective fungibility resume state: the host would start
-                # the flavor walk mid-list (flavorassigner.go:359-366);
-                # the device always scans from slot 0
+                # effective fungibility resume state: the host starts the
+                # flavor walk mid-list (flavorassigner.go:359-366); the
+                # vector classify always scans from slot 0
                 cq = snapshot.cq(h.cluster_queue)
                 if (cq is not None and
                         last.cluster_queue_generation >= cq.allocatable_generation):
-                    return False
-            for ps in h.obj.pod_sets:
-                if ps.topology_request is not None:
-                    return False
-                if ps.min_count is not None:
-                    return False
-                if ps.node_selector or ps.required_node_affinity or ps.tolerations:
-                    return False  # affinity/taint matching stays on host
-        return True
+                    mask[wi] = True
+        return mask
 
     # -- phase 1 -------------------------------------------------------
 
     def classify(self, snapshot: Snapshot,
                  heads: list[Info]) -> Optional[ClassifiedCycle]:
-        """Pack + vectorized nominate.  None → run the host path."""
+        """Pack + vectorized nominate.  None → run the host path.
+
+        Heads the vector math can't cover are flagged in ``scalar_mask``
+        (their vector rows are cleared); the scheduler host-walks those
+        and attaches the assignments via ``attach_host_assignment``."""
         if not heads:
             return None
         st = self._structure_for(snapshot, heads)
-        if not getattr(st, "static_eligible", False):
-            return None
-        if not self._heads_eligible(snapshot, heads):
-            return None
         packed = pack_cycle(snapshot, heads, self.ordering, structure=st)
         if packed is None:
             # topology drifted under an unchanged generation (defensive):
             # rebuild once and retry
             self._structure = None
             st = self._structure_for(snapshot, heads)
-            if not getattr(st, "static_eligible", False):
-                return None
             packed = pack_cycle(snapshot, heads, self.ordering, structure=st)
             if packed is None:
                 return None
         if not packed.exact:
             # lossy int32 scaling could deny fits the host grants
             return None
+        scalar = self._scalar_mask(snapshot, heads, st)
         if self._potential0 is None or self._potential0.shape != packed.usage0.shape:
             from .cycle import available_all_np
             self._potential0 = available_all_np(
@@ -414,13 +463,138 @@ class CycleSolver:
                     out[k] = det[k]
         else:
             out = classify_np(packed, potential0=self._potential0)
+        n = packed.wl_count
+        W = packed.wl_cq.shape[0]
+        # partial admission: a min_count head whose FULL counts fit is
+        # decision-identical to a plain head; otherwise the host runs the
+        # PodSetReducer binary search (podset_reducer.go) — scalar walk
+        for wi in range(n):
+            if scalar[wi] or out["fit_slot0"][wi] >= 0:
+                continue
+            if any(ps.min_count is not None and ps.min_count < ps.count
+                   for ps in heads[wi].obj.pod_sets):
+                scalar[wi] = True
+        if scalar.any():
+            # clear the vector rows for scalar heads: their decisions come
+            # from the attached host assignments instead
+            sm = np.zeros(W, dtype=bool)
+            sm[:n] = scalar
+            out = dict(out)
+            out["fit_slot0"] = np.where(sm, -1, out["fit_slot0"]).astype(np.int32)
+            out["borrows0"] = out["borrows0"] & ~sm
+            out["preempt0"] = out["preempt0"] & ~sm
+            out["preempt_slot0"] = np.where(sm, -1, out["preempt_slot0"]).astype(np.int32)
+            out["preempt_borrows0"] = out["preempt_borrows0"] & ~sm
+            self.stats["scalar_heads"] += int(scalar.sum())
+        else:
+            sm = np.zeros(W, dtype=bool)
         return ClassifiedCycle(
             packed=packed, heads=heads, snapshot=snapshot,
             fit_slot0=out["fit_slot0"], borrows0=out["borrows0"],
             preempt0=out["preempt0"], preempt_slot0=out["preempt_slot0"],
             preempt_borrows0=out["preempt_borrows0"],
             preempt_res_fit=out["preempt_res_fit"],
-            preempt_slot_count=out["preempt_slot_count"])
+            preempt_slot_count=out["preempt_slot_count"],
+            scalar_mask=sm, host_assignments={}, host_pairs={})
+
+    # -- scalar-head decisions -----------------------------------------
+
+    def attach_host_assignment(self, cls: ClassifiedCycle, wi: int,
+                               assignment) -> bool:
+        """Record a host-walked head's assignment for the admit scan.
+
+        The assignment's usage map becomes the head's decision pairs.
+        Returns False when the usage can't be represented in the cached
+        structure (unknown flavor-resource or inexact scaling) — the
+        caller then falls the whole cycle back to the host."""
+        pairs = self._assignment_pairs(cls, assignment)
+        if pairs is None:
+            return False
+        cls.host_assignments[wi] = assignment
+        cls.host_pairs[wi] = pairs
+        return True
+
+    def _assignment_pairs(self, cls: ClassifiedCycle, assignment
+                          ) -> Optional[list[tuple[int, int]]]:
+        """assignment.usage → [(F-index, scaled amount)], or None."""
+        st = cls.packed.structure
+        scale_of = {r: int(st.resource_scale[i])
+                    for i, r in enumerate(st.resource_names)}
+        pairs = []
+        for fr, v in assignment.usage.items():
+            fi = st.fr_index.get(fr)
+            if fi is None:
+                return None
+            s = scale_of.get(fr.resource)
+            if s is None or v % s:
+                return None
+            q = v // s
+            if q > 2**31 - 1:
+                return None
+            pairs.append((fi, int(q)))
+        return pairs
+
+    def _build_pair_tensors(self, cls: ClassifiedCycle,
+                            rmask: np.ndarray, pmask: np.ndarray):
+        """Merge vector and scalar classifications into the scan's
+        decision-pair tensors.
+
+        Returns (dec_fr, dec_amt, fit_mask, res_fr, res_amt, res_borrows,
+        pre_fr, pre_amt, borrows) — all [W, K] / [W]."""
+        packed = cls.packed
+        st = packed.structure
+        W = packed.wl_cq.shape[0]
+        R = len(st.resource_names)
+
+        # vector fit heads: pairs from the chosen slot (batched)
+        dec_fr, dec_amt, fit_mask = decision_pairs_from_slots(
+            st.slot_fr, packed.wl_cq, packed.wl_requests, cls.fit_slot0)
+        # vector reserve/preempt entries: pairs from the preempt slot
+        pre_on = rmask | pmask
+        pslot = np.where(pre_on & (cls.preempt_slot0 >= 0),
+                         cls.preempt_slot0, -1).astype(np.int32)
+        res_fr, res_amt, _ = decision_pairs_from_slots(
+            st.slot_fr, packed.wl_cq, packed.wl_requests, pslot)
+        res_borrows = cls.preempt_borrows0 & pre_on
+        borrows = cls.borrows0.copy()
+        borrows |= res_borrows
+
+        scalar_pairs = cls.host_pairs
+        max_k = R
+        for pairs in scalar_pairs.values():
+            max_k = max(max_k, len(pairs))
+        if max_k > R:
+            K = _bucket(max_k, minimum=R if R >= 8 else 8)
+            pad = np.full((W, K - R), -1, np.int32)
+            zpad = np.zeros((W, K - R), np.int32)
+            dec_fr = np.concatenate([dec_fr, pad], axis=1)
+            dec_amt = np.concatenate([dec_amt, zpad], axis=1)
+            res_fr = np.concatenate([res_fr, pad], axis=1)
+            res_amt = np.concatenate([res_amt, zpad], axis=1)
+
+        for wi, assignment in cls.host_assignments.items():
+            pairs = scalar_pairs[wi]
+            mode = assignment.representative_mode()
+            is_fit = mode == Mode.FIT
+            fit_mask[wi] = is_fit
+            dec_fr[wi] = -1
+            dec_amt[wi] = 0
+            res_fr[wi] = -1
+            res_amt[wi] = 0
+            if is_fit:
+                for k, (fi, q) in enumerate(pairs):
+                    dec_fr[wi, k] = fi
+                    dec_amt[wi, k] = q
+            elif rmask[wi] or pmask[wi]:
+                for k, (fi, q) in enumerate(pairs):
+                    res_fr[wi, k] = fi
+                    res_amt[wi, k] = q
+                res_borrows[wi] = assignment.borrows()
+            borrows[wi] = assignment.borrows()
+        # preempt entries re-check fits on the same pairs they charge
+        pre_fr, pre_amt = res_fr, res_amt
+        return (dec_fr, dec_amt, fit_mask, res_fr, res_amt, res_borrows,
+                pre_fr, pre_amt, borrows)
 
     # -- phase 2 -------------------------------------------------------
 
@@ -519,14 +693,16 @@ class CycleSolver:
         rmask[:len(reserve_mask)] = reserve_mask
         pmask = (targets.preempt_mask if targets is not None
                  else np.zeros(W, dtype=bool))
-        borrows = cls.borrows0 | (cls.preempt_borrows0 & (rmask | pmask))
+        (dec_fr, dec_amt, fit_mask, res_fr, res_amt, res_borrows,
+         pre_fr, pre_amt, borrows) = self._build_pair_tensors(
+            cls, rmask, pmask)
         order = cycle_order_np(borrows, packed.wl_priority,
                                packed.wl_timestamp)
         self.stats["reserve_entries"] += int(rmask[:n].sum())
         handle = DispatchHandle(order=order, rmask=rmask, n=n)
+        handle.fit_mask = fit_mask
         zeros = np.zeros(W, dtype=bool)
 
-        fit_mask = cls.fit_slot0 >= 0
         if not pmask.any():
             handle.preempting = zeros
             handle.overlap_skip = zeros
@@ -559,15 +735,14 @@ class CycleSolver:
             self.stats["cpu_dispatches"] += 1
             handle.route = "cpu"
         args = (packed.usage0, st.subtree_quota, st.guaranteed,
-                st.borrow_cap, st.has_borrow_limit, st.parent, st.slot_fr,
+                st.borrow_cap, st.has_borrow_limit, st.parent,
                 st.nominal_cq, st.nominal_plus_blimit_cq, packed.wl_cq,
-                packed.wl_requests, cls.fit_slot0, rmask,
-                np.maximum(cls.preempt_slot0, 0),
-                cls.preempt_borrows0 & rmask)
+                dec_fr, dec_amt, fit_mask, res_fr, res_amt, rmask,
+                res_borrows)
         with jax.default_device(dev):
             if pmask.any():
                 handle.pending = admit_scan_preempt(
-                    *args, pmask, np.maximum(cls.preempt_slot0, 0),
+                    *args, pmask, pre_fr, pre_amt,
                     targets.tgt_mat, targets.tu_cq, targets.tu_delta,
                     order, depth=st.depth)
             elif mfw is not None:
@@ -750,7 +925,7 @@ class CycleSolver:
         if cls is None:
             self.stats["host_cycles"] += 1
             return None
-        if cls.preempt0[:cls.n].any():
+        if cls.preempt0[:cls.n].any() or cls.scalar_mask[:cls.n].any():
             self.stats["host_cycles"] += 1
             return None
         self.stats["classify_cycles"] += 1

@@ -273,38 +273,75 @@ class Scheduler:
         full_ok = True
         targets_by_wi: dict[int, list] = {}
         assignments_by_wi: dict[int, Assignment] = {}
+        walked: set[int] = set()
         self.preemptor.set_cycle_pack(snapshot, cls.packed)
-        for wi in np.nonzero(cls.preempt0[:n])[0]:
-            wi = int(wi)
-            # Exactly one preempt-capable slot required: with several, the
-            # host walk's choice depends on the reclaim oracle
-            # (flavorassigner.go:692 RECLAIM beats PREEMPT).
-            if cls.preempt_slot_count[wi] != 1:
+
+        def scalar_walk(wi: int) -> bool:
+            """Host FlavorAssigner walk for one head (nominate-time,
+            snapshot state) — multi-RG/multi-PodSet/taints/fungibility/
+            resume-state/partial-admission/TAS heads stay inside the
+            device-decided cycle this way."""
+            e = deferred[wi]
+            e.inadmissible_msg = ""
+            self._assign_entry(e, snapshot)
+            walked.add(wi)
+            if not cls.scalar_mask[wi]:
+                # promoted post-classify (multi-preempt-slot head)
+                cls.scalar_mask[wi] = True
+                solver.stats["scalar_heads"] += 1
+            a = e.assignment
+            mode = a.representative_mode()
+            if mode == Mode.NO_FIT:
+                return True
+            if not solver.attach_host_assignment(cls, wi, a):
+                return False
+            if mode == Mode.PREEMPT:
+                if e.preemption_targets:
+                    targets_by_wi[wi] = e.preemption_targets
+                    assignments_by_wi[wi] = a
+                else:
+                    reserve[wi] = True
+            return True
+
+        for wi in np.nonzero(cls.scalar_mask[:n])[0]:
+            if not scalar_walk(int(wi)):
                 full_ok = False
                 break
-            frs_need, usage = solver.preemption_probe(cls, wi)
-            e = deferred[wi]
-            from .preemption import _PreemptionCtx
-            ctx = _PreemptionCtx(
-                preemptor=e.info,
-                preemptor_cq=snapshot.cq(e.info.cluster_queue),
-                snapshot=snapshot,
-                frs_need_preemption=frs_need,
-                workload_usage=usage)
-            if not self.preemptor._find_candidates(ctx):
-                reserve[wi] = True
-                continue
-            # preempt head WITH candidates: run the real target search at
-            # nominate (device-backed minimalPreemptions) so the cycle
-            # stays fully device-decided (preemption.go:127-191)
-            assignment = solver.build_preempt_assignment(cls, wi)
-            targets = self.preemptor.get_targets(e.info, assignment,
-                                                 snapshot)
-            if targets:
-                targets_by_wi[wi] = targets
-                assignments_by_wi[wi] = assignment
-            else:
-                reserve[wi] = True
+
+        if full_ok:
+            for wi in np.nonzero(cls.preempt0[:n])[0]:
+                wi = int(wi)
+                # With several preempt-capable slots the host walk's choice
+                # depends on the reclaim oracle (flavorassigner.go:692
+                # RECLAIM beats PREEMPT) — run the real walk for this head.
+                if cls.preempt_slot_count[wi] != 1:
+                    if not scalar_walk(wi):
+                        full_ok = False
+                        break
+                    continue
+                frs_need, usage = solver.preemption_probe(cls, wi)
+                e = deferred[wi]
+                from .preemption import _PreemptionCtx
+                ctx = _PreemptionCtx(
+                    preemptor=e.info,
+                    preemptor_cq=snapshot.cq(e.info.cluster_queue),
+                    snapshot=snapshot,
+                    frs_need_preemption=frs_need,
+                    workload_usage=usage)
+                if not self.preemptor._find_candidates(ctx):
+                    reserve[wi] = True
+                    continue
+                # preempt head WITH candidates: run the real target search
+                # at nominate (device-backed minimalPreemptions) so the
+                # cycle stays fully device-decided (preemption.go:127-191)
+                assignment = solver.build_preempt_assignment(cls, wi)
+                targets = self.preemptor.get_targets(e.info, assignment,
+                                                     snapshot)
+                if targets:
+                    targets_by_wi[wi] = targets
+                    assignments_by_wi[wi] = assignment
+                else:
+                    reserve[wi] = True
 
         packed_targets = None
         if full_ok and targets_by_wi:
@@ -315,6 +352,8 @@ class Scheduler:
         if not full_ok:
             solver.stats["classify_cycles"] += 1
             for wi, e in enumerate(deferred):
+                if wi in walked:
+                    continue  # the host walk already ran for this head
                 e.inadmissible_msg = ""
                 if cls.fit_slot0[wi] >= 0:
                     e.assignment = solver.build_fit_assignment(cls, wi)
@@ -327,7 +366,8 @@ class Scheduler:
 
         handle = solver.dispatch(cls, reserve, packed_targets)
         solver.stats["full_cycles"] += 1
-        return (deferred, cls, handle, assignments_by_wi, targets_by_wi)
+        return (deferred, cls, handle, assignments_by_wi, targets_by_wi,
+                walked)
 
     def _admit_device_cycle(self, device, snapshot: Snapshot,
                             stats: CycleStats) -> None:
@@ -340,11 +380,15 @@ class Scheduler:
         reserve messages, NoFit walks, speculative admit objects) runs
         FIRST, overlapped with the device execution; ``solver.fetch`` then
         blocks only for whatever latency is left."""
-        deferred, cls, handle, assignments_by_wi, targets_by_wi = device
+        deferred, cls, handle, assignments_by_wi, targets_by_wi, walked = device
         solver = self.solver
         n = cls.n
         for wi in range(n):
             e = deferred[wi]
+            if wi in walked:
+                # scalar head: the host walk already produced the
+                # assignment, message, resume state, and targets
+                continue
             if cls.fit_slot0[wi] >= 0:
                 e.assignment = solver.build_fit_assignment(cls, wi)
                 e.info.last_assignment = e.assignment.last_state
@@ -368,7 +412,7 @@ class Scheduler:
             # admission objects for every fit head while the chip works
             for wi in range(n):
                 e = deferred[wi]
-                if cls.fit_slot0[wi] >= 0:
+                if handle.fit_mask[wi]:
                     cq = snapshot.cq(e.info.cluster_queue)
                     if cq is not None:
                         self._prepare_admit(e, cq)
@@ -406,7 +450,7 @@ class Scheduler:
                 # preempt entry that no longer fits after earlier entries
                 self._set_skipped(e, "Workload no longer fits after "
                                      "processing another workload")
-            elif cls.fit_slot0[wi] >= 0:
+            elif handle.fit_mask[wi]:
                 # fit at nominate, lost capacity in-scan (scheduler.go:245)
                 self._set_skipped(e, "Workload no longer fits after "
                                      "processing another workload")
