@@ -22,11 +22,13 @@ Per cycle the solver:
    re-checks (scheduler.go:372) — so HOW a head was classified (vector or
    scalar) is invisible to the kernel.
 
-Falls back (returns None) for fair-sharing cycles (tournament ordering),
-inexact int32 scaling, unrepresentable packs (a flavor-resource or node
-unknown to the cached structure after one rebuild), and scalar
-assignments whose usage can't be encoded exactly — the host path then
-runs, keeping decisions bit-identical.
+Fair-sharing cycles use ``classify`` for nominate but keep the host
+admit loop (the tournament's within-cycle ordering is data-dependent on
+DRS — see Scheduler._fair_sharing_iterator).  The solver falls back
+entirely (returns None) for inexact int32 scaling, unrepresentable packs
+(a flavor-resource or node unknown to the cached structure after one
+rebuild), and scalar assignments whose usage can't be encoded exactly —
+the host path then runs, keeping decisions bit-identical.
 """
 
 from __future__ import annotations

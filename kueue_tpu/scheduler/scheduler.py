@@ -111,6 +111,10 @@ class Scheduler:
         self.on_requeue: Callable[[Entry], None] = lambda e: None
         # Optional batched device solver (kueue_tpu.ops.solver.CycleSolver).
         self.solver = solver
+        # Fair-sharing tournament backend: batched TournamentDRS (default)
+        # vs the scalar per-entry computeDRS (parity oracle).
+        self.fs_batched = True
+        self._fs_tracker = None
         # Optional metrics registry (set by the driver).
         self.metrics = None
         # Namespace → limitrange.Summary (set by the driver).
@@ -156,6 +160,7 @@ class Scheduler:
                 if cq is not None:
                     usage = self._resources_to_reserve(e, cq)
                     cq.simulate_usage_addition(usage)  # revert discarded: snapshot-local
+                    self._note_fs_usage(e.info.cluster_queue, usage)
                 continue
 
             if any(t.info.key in preempted_workloads for t in e.preemption_targets):
@@ -173,6 +178,7 @@ class Scheduler:
             for t in e.preemption_targets:
                 preempted_workloads[t.info.key] = t.info
             cq.simulate_usage_addition(usage)
+            self._note_fs_usage(e.info.cluster_queue, usage)
 
             if e.assignment.representative_mode() == Mode.PREEMPT:
                 e.info.last_assignment = None  # retry all flavors next time
@@ -228,7 +234,7 @@ class Scheduler:
                 e.requeue_reason = RequeueReason.NAMESPACE_MISMATCH
             elif not self._validate_resources(info):
                 e.inadmissible_msg = "resource validation failed"
-            elif self.solver is not None and not self.fair_sharing:
+            elif self.solver is not None:
                 e.status = EntryStatus.NOT_NOMINATED
                 e.inadmissible_msg = "__deferred__"  # batched assignment below
             else:
@@ -267,6 +273,14 @@ class Scheduler:
             for e in deferred:
                 e.inadmissible_msg = ""
                 self._assign_entry(e, snapshot)
+            return None
+        if self.fair_sharing:
+            # fair-sharing cycles: device classification replaces the
+            # per-head flavor walk for Fit heads; the admit loop runs the
+            # host tournament (fair_sharing_iterator.go) — the within-
+            # cycle ordering is data-dependent on DRS, not scannable
+            solver.stats["classify_cycles"] += 1
+            self._assign_classified(deferred, cls, snapshot, set())
             return None
         n = cls.n
         reserve = np.zeros(n, dtype=bool)
@@ -351,23 +365,31 @@ class Scheduler:
 
         if not full_ok:
             solver.stats["classify_cycles"] += 1
-            for wi, e in enumerate(deferred):
-                if wi in walked:
-                    continue  # the host walk already ran for this head
-                e.inadmissible_msg = ""
-                if cls.fit_slot0[wi] >= 0:
-                    e.assignment = solver.build_fit_assignment(cls, wi)
-                    e.info.last_assignment = e.assignment.last_state
-                else:
-                    # preempt/nofit heads need the host walk (targets,
-                    # exact reasons, resume state)
-                    self._assign_entry(e, snapshot)
+            self._assign_classified(deferred, cls, snapshot, walked)
             return None
 
         handle = solver.dispatch(cls, reserve, packed_targets)
         solver.stats["full_cycles"] += 1
         return (deferred, cls, handle, assignments_by_wi, targets_by_wi,
                 walked)
+
+    def _assign_classified(self, deferred: list[Entry], cls, snapshot,
+                           walked: set[int]) -> None:
+        """Classify-mode assignment: device-classified Fit heads get the
+        reconstructed assignment, everything else (scalar, preempt, NoFit)
+        runs the host walk — the host admit loop takes over from here."""
+        solver = self.solver
+        for wi, e in enumerate(deferred):
+            if wi in walked:
+                continue  # the host walk already ran for this head
+            e.inadmissible_msg = ""
+            if not cls.scalar_mask[wi] and cls.fit_slot0[wi] >= 0:
+                e.assignment = solver.build_fit_assignment(cls, wi)
+                e.info.last_assignment = e.assignment.last_state
+            else:
+                # preempt/nofit/scalar heads need the host walk (targets,
+                # exact reasons, resume state)
+                self._assign_entry(e, snapshot)
 
     def _admit_device_cycle(self, device, snapshot: Snapshot,
                             stats: CycleStats) -> None:
@@ -538,70 +560,130 @@ class Scheduler:
                     self.ordering.queue_order_timestamp(e.obj))
         return iter(sorted(entries, key=sort_key))
 
+    def _fs_less(self, a: Entry, b: Entry, parent: str, drs_values) -> bool:
+        """entryComparer.less (fair_sharing_iterator.go:167)."""
+        a_drs = drs_values.get((parent, a.info.key), 0)
+        b_drs = drs_values.get((parent, b.info.key), 0)
+        if a_drs != b_drs:
+            return a_drs < b_drs
+        if a.obj.priority != b.obj.priority:
+            return a.obj.priority > b.obj.priority
+        return (self.ordering.queue_order_timestamp(a.obj)
+                < self.ordering.queue_order_timestamp(b.obj))
+
+    def _fs_tournament(self, cohort, remaining: dict[str, Entry],
+                       drs_values) -> Optional[Entry]:
+        """runTournament (fair_sharing_iterator.go:121)."""
+        candidates = []
+        for child in cohort.child_cohorts:
+            cand = self._fs_tournament(child, remaining, drs_values)
+            if cand is not None:
+                candidates.append(cand)
+        for cq in cohort.child_cqs:
+            cand = remaining.get(cq.name)
+            if cand is not None and cand.cq_snapshot is cq:
+                candidates.append(cand)
+        if not candidates:
+            return None
+        best = candidates[0]
+        for cur in candidates[1:]:
+            if self._fs_less(cur, best, cohort.name, drs_values):
+                best = cur
+        return best
+
+    def _fs_drs_values_ref(self, remaining: dict[str, Entry]
+                           ) -> dict[tuple[str, str], int]:
+        """Scalar per-entry computeDRS (simulate + revert per entry) —
+        the oracle the batched TournamentDRS is parity-tested against,
+        and the fallback when the tracker can't represent an entry."""
+        drs_values: dict[tuple[str, str], int] = {}
+        for cq_name, e in remaining.items():
+            cq = e.cq_snapshot
+            revert = cq.simulate_usage_addition(e.assignment.usage)
+            drs_values[(getattr(cq.parent, "name", ""), e.info.key)] = (
+                dominant_resource_share(cq)[0])
+            cohort = cq.parent
+            while cohort is not None and cohort.parent is not None:
+                drs_values[(cohort.parent.name, e.info.key)] = (
+                    dominant_resource_share(cohort)[0])
+                cohort = cohort.parent
+            revert()
+        return drs_values
+
     def _fair_sharing_iterator(self, entries: list[Entry], snapshot: Snapshot):
         """Per-cohort tournament minimizing post-admission DRS
-        (reference fair_sharing_iterator.go:121)."""
+        (reference fair_sharing_iterator.go:121).
+
+        Per round the DRS values for ALL remaining entries come from one
+        batched TournamentDRS pass over packed int64 tensors; the admit
+        loop's usage mutations are mirrored in via ``_note_fs_usage`` so
+        no per-round repack or per-entry simulate/revert happens.  Falls
+        back to the scalar computeDRS when an entry's usage can't be
+        packed (unseen flavor-resource)."""
+        import numpy as np
+        from ..ops.fairsharing_kernel import TournamentDRS
+
         remaining: dict[str, Entry] = {
             e.info.cluster_queue: e for e in entries if e.cq_snapshot is not None}
         no_cq = [e for e in entries if e.cq_snapshot is None]
         yield from no_cq
 
-        def compute_drs_values() -> dict[tuple[str, str], int]:
-            drs_values: dict[tuple[str, str], int] = {}
+        tracker = TournamentDRS(snapshot) if self.fs_batched else None
+        vecs: dict[str, np.ndarray] = {}
+        if tracker is not None:
             for cq_name, e in remaining.items():
-                cq = e.cq_snapshot
-                revert = cq.simulate_usage_addition(e.assignment.usage)
-                drs_values[(getattr(cq.parent, "name", ""), e.info.key)] = (
-                    dominant_resource_share(cq)[0])
-                cohort = cq.parent
-                while cohort is not None and cohort.parent is not None:
-                    drs_values[(cohort.parent.name, e.info.key)] = (
-                        dominant_resource_share(cohort)[0])
-                    cohort = cohort.parent
-                revert()
-            return drs_values
+                if tracker.cq_index.get(cq_name) is None:
+                    tracker = None
+                    break
+                vec = tracker.u_vec(e.assignment.usage)
+                if vec is None:
+                    tracker = None
+                    break
+                vecs[cq_name] = vec
+        self._fs_tracker = tracker
+        try:
+            while remaining:
+                cq_name = next(iter(remaining))
+                cq = remaining[cq_name].cq_snapshot
+                if cq.parent is None:
+                    yield remaining.pop(cq_name)
+                    continue
+                if tracker is not None and not tracker.stale:
+                    keys = list(remaining)
+                    cq_is = np.array([tracker.cq_index[k] for k in keys],
+                                     dtype=np.int64)
+                    u_es = np.stack([vecs[k] for k in keys])
+                    paths, drs = tracker.drs_for(cq_is, u_es)
+                    drs_values: dict[tuple[str, str], int] = {}
+                    for j, k in enumerate(keys):
+                        wl_key = remaining[k].info.key
+                        for level in range(paths.shape[1]):
+                            node = int(paths[j, level])
+                            if node < 0:
+                                break
+                            par = int(tracker.parent[node])
+                            if par < 0:
+                                break
+                            drs_values[(tracker.names[par], wl_key)] = int(
+                                drs[j, level])
+                else:
+                    drs_values = self._fs_drs_values_ref(remaining)
+                winner = self._fs_tournament(cq.parent.root(), remaining,
+                                             drs_values)
+                if winner is None:
+                    yield remaining.pop(cq_name)
+                    continue
+                del remaining[winner.info.cluster_queue]
+                yield winner
+        finally:
+            self._fs_tracker = None
 
-        def less(a: Entry, b: Entry, parent: str, drs_values) -> bool:
-            a_drs = drs_values.get((parent, a.info.key), 0)
-            b_drs = drs_values.get((parent, b.info.key), 0)
-            if a_drs != b_drs:
-                return a_drs < b_drs
-            if a.obj.priority != b.obj.priority:
-                return a.obj.priority > b.obj.priority
-            return (self.ordering.queue_order_timestamp(a.obj)
-                    < self.ordering.queue_order_timestamp(b.obj))
-
-        def tournament(cohort, drs_values) -> Optional[Entry]:
-            candidates = []
-            for child in cohort.child_cohorts:
-                cand = tournament(child, drs_values)
-                if cand is not None:
-                    candidates.append(cand)
-            for cq in cohort.child_cqs:
-                cand = remaining.get(cq.name)
-                if cand is not None and cand.cq_snapshot is cq:
-                    candidates.append(cand)
-            if not candidates:
-                return None
-            best = candidates[0]
-            for cur in candidates[1:]:
-                if less(cur, best, cohort.name, drs_values):
-                    best = cur
-            return best
-
-        while remaining:
-            cq_name = next(iter(remaining))
-            cq = remaining[cq_name].cq_snapshot
-            if cq.parent is None:
-                yield remaining.pop(cq_name)
-                continue
-            drs_values = compute_drs_values()
-            winner = tournament(cq.parent.root(), drs_values)
-            if winner is None:
-                yield remaining.pop(cq_name)
-                continue
-            del remaining[winner.info.cluster_queue]
-            yield winner
+    def _note_fs_usage(self, cq_name: str, usage) -> None:
+        """Mirror an admit-loop usage mutation into the tournament's
+        packed tensor (called after simulate_usage_addition)."""
+        t = self._fs_tracker
+        if t is not None:
+            t.note_add(cq_name, usage)
 
     # ------------------------------------------------------------------
     # Admission mechanics
