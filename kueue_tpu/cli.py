@@ -55,8 +55,13 @@ class Store:
 
     def save(self) -> None:
         os.makedirs(self.state_dir, exist_ok=True)
-        with open(os.path.join(self.state_dir, STATE_FILE), "w") as f:
+        path = os.path.join(self.state_dir, STATE_FILE)
+        # write-then-rename: readers (the serve watcher) never see a
+        # truncated/partial file
+        tmp = path + ".tmp"
+        with open(tmp, "w") as f:
             json.dump(self.docs, f, indent=1)
+        os.replace(tmp, path)
 
     # -- doc helpers ---------------------------------------------------
 
@@ -88,6 +93,26 @@ class Store:
         return None
 
 
+def apply_spec(d: Driver, doc: dict) -> None:
+    """Apply one non-Workload manifest to a driver."""
+    kind = doc.get("kind")
+    obj = m.from_manifest(doc)
+    if kind == "ResourceFlavor":
+        d.apply_resource_flavor(obj)
+    elif kind == "Topology":
+        d.apply_topology(obj)
+    elif kind == "AdmissionCheck":
+        d.apply_admission_check(obj)
+    elif kind == "WorkloadPriorityClass":
+        d.apply_workload_priority_class(obj)
+    elif kind == "Cohort":
+        d.apply_cohort(obj)
+    elif kind == "ClusterQueue":
+        d.apply_cluster_queue(obj)
+    elif kind == "LocalQueue":
+        d.apply_local_queue(obj)
+
+
 def build_driver(store: Store) -> Driver:
     """Replay the store into a fresh Driver."""
     d = Driver()
@@ -95,21 +120,7 @@ def build_driver(store: Store) -> Driver:
              "WorkloadPriorityClass", "Cohort", "ClusterQueue", "LocalQueue"]
     for kind in order:
         for doc in store.by_kind(kind):
-            obj = m.from_manifest(doc)
-            if kind == "ResourceFlavor":
-                d.apply_resource_flavor(obj)
-            elif kind == "Topology":
-                d.apply_topology(obj)
-            elif kind == "AdmissionCheck":
-                d.apply_admission_check(obj)
-            elif kind == "WorkloadPriorityClass":
-                d.apply_workload_priority_class(obj)
-            elif kind == "Cohort":
-                d.apply_cohort(obj)
-            elif kind == "ClusterQueue":
-                d.apply_cluster_queue(obj)
-            elif kind == "LocalQueue":
-                d.apply_local_queue(obj)
+            apply_spec(d, doc)
     for doc in store.by_kind("Workload"):
         d.restore_workload(m.from_manifest(doc))
     return d
@@ -312,6 +323,115 @@ def cmd_state(store: Store, args) -> int:
     return 0
 
 
+def cmd_serve(store: Store, args) -> int:
+    """Daemon mode (reference cmd/kueue manager + scheduler Runnable):
+    a long-running admission loop over blocking heads with speed-signal
+    backoff, a store watcher that picks up `cli apply` edits from other
+    processes, SIGUSR2 state dumps, and graceful SIGINT/SIGTERM
+    shutdown with workload status persisted back to the store."""
+    import signal as _signal
+    import threading
+
+    stop = threading.Event()
+
+    # leader election: exactly one daemon per store (reference
+    # config.go:97 leader election; the scheduler runs only when elected)
+    from .leaderelection import FileLease
+    lease = FileLease(args.state_dir)
+    if not lease.try_acquire():
+        print(f"waiting for leadership on {args.state_dir}", flush=True)
+        if not lease.acquire(stop):
+            return 0
+    store = Store(args.state_dir)  # reload: the old leader wrote status
+    driver = build_driver(store)
+
+    from .debugger import Dumper
+    dumper = Dumper(driver)
+    try:
+        dumper.listen_for_signal()          # SIGUSR2 → state dump
+        for sig in (_signal.SIGINT, _signal.SIGTERM):
+            _signal.signal(sig, lambda *_: stop.set())
+    except ValueError:
+        pass  # not on the main thread (tests drive serve threaded)
+
+    store_path = os.path.join(store.state_dir, STATE_FILE)
+
+    def store_stat():
+        try:
+            st = os.stat(store_path)
+            return (st.st_mtime_ns, st.st_size)
+        except OSError:
+            return None
+
+    seen_stat = store_stat()
+
+    def watch_store():
+        """Poll the store file; mirror spec changes made by other
+        processes (the API-server watch equivalent).  New workloads are
+        restored (admitted status charges the cache — cli import),
+        removed ones are deleted.  Any torn read or bad manifest skips
+        the poll; the watcher never dies."""
+        nonlocal seen_stat
+        while not stop.wait(args.poll_interval):
+            try:
+                st = store_stat()
+                if st is None or st == seen_stat:
+                    continue
+                seen_stat = st
+                fresh = Store(args.state_dir)
+                store_keys = set()
+                for doc in fresh.docs:
+                    kind = doc.get("kind")
+                    if kind == "Workload":
+                        meta = doc.get("metadata") or {}
+                        key = (f"{meta.get('namespace', 'default')}"
+                               f"/{meta.get('name')}")
+                        store_keys.add(key)
+                        if driver.workload(key) is None:
+                            driver.restore_workload(m.from_manifest(doc))
+                    elif kind:
+                        apply_spec(driver, doc)
+                for key in list(driver.workloads):
+                    if key not in store_keys:
+                        driver.delete_workload(key)
+                driver.queues.broadcast()
+            except Exception as exc:       # torn read / bad manifest
+                print(f"store watch: skipping poll: {exc}", flush=True)
+
+    def drained() -> bool:
+        """No workload can make progress: every active heap is empty
+        (parked-inadmissible workloads wait on events, not cycles)."""
+        return not any(driver.queues.pending_active_workloads(name)
+                       for name in driver.queues.cluster_queue_names())
+
+    watcher = threading.Thread(target=watch_store, daemon=True)
+    watcher.start()
+    if args.exit_when_drained:
+        def drain_check():
+            while not stop.wait(0.1):
+                if drained():
+                    stop.set()
+        threading.Thread(target=drain_check, daemon=True).start()
+
+    print(f"serving from {args.state_dir} (SIGUSR2 dumps state, "
+          f"SIGTERM stops)", flush=True)
+    try:
+        driver.run(stop)                     # blocks until stop
+        # status write-back against a FRESH store read: spec edits made
+        # by other processes while serving are preserved, and workloads
+        # deleted from the store stay deleted
+        final = Store(args.state_dir)
+        for wl in list(driver.workloads.values()):
+            if final.get("Workload", wl.name, wl.namespace) is not None:
+                final.upsert(m.to_manifest(wl))
+        final.save()
+    finally:
+        lease.release()
+    admitted = sorted(driver.admitted_keys())
+    print(f"serve exiting: {len(admitted)} workloads holding quota")
+    return 0
+
+
 def cmd_import(store: Store, args) -> int:
     """cmd/importer equivalent: adopt already-running pods as admitted
     workloads (check + import phases)."""
@@ -401,6 +521,12 @@ def main(argv: list[str] | None = None) -> int:
 
     sub.add_parser("state", help="dump queues/cache state")
 
+    p = sub.add_parser("serve", help="run the admission daemon")
+    p.add_argument("--poll-interval", type=float, default=0.5,
+                   help="store-watch poll interval (seconds)")
+    p.add_argument("--exit-when-drained", action="store_true",
+                   help="exit once no workloads are pending (tests)")
+
     p = sub.add_parser("import", help="bulk-import running pods")
     p.add_argument("-f", "--filename", required=True)
     p.add_argument("--queue-label", default="kueue.x-k8s.io/queue-name")
@@ -415,7 +541,7 @@ def main(argv: list[str] | None = None) -> int:
     handlers = {
         "apply": cmd_apply, "create": cmd_create, "list": cmd_list,
         "delete": cmd_delete, "schedule": cmd_schedule, "state": cmd_state,
-        "import": cmd_import,
+        "import": cmd_import, "serve": cmd_serve,
         "stop": lambda s, a: _set_stop_policy(s, a, StopPolicy.HOLD_AND_DRAIN),
         "resume": lambda s, a: _set_stop_policy(s, a, StopPolicy.NONE),
     }

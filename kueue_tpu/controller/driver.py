@@ -497,6 +497,19 @@ class Driver:
         self.metrics.admission_attempt(bool(stats.admitted), stats.duration_s)
         return stats
 
+    def run(self, stop_event, heads_timeout: float = 0.2) -> None:
+        """Daemon mode: the long-running admission loop over blocking
+        ``queues.heads()`` with the speed-signal backoff (reference
+        scheduler.go:143 Start driven by wait.UntilWithBackoff).  Blocks
+        until ``stop_event`` is set; producers on other threads create
+        workloads through the normal Driver API and the loop admits them
+        as they arrive."""
+        def on_cycle(stats):
+            self.metrics.admission_attempt(bool(stats.admitted),
+                                           stats.duration_s)
+        self.scheduler.run(stop_event, heads_timeout=heads_timeout,
+                           on_cycle=on_cycle)
+
     def run_until_settled(self, max_cycles: int = 1000):
         """Run cycles until a fixed point: no admissions/preemptions AND the
         queue state fingerprint repeats (a cycle that merely parks a blocked

@@ -268,6 +268,13 @@ class Manager:
             q = self._mgr.cluster_queues.get(cq_name)
             return q.pending() if q else 0
 
+    def pending_active_workloads(self, cq_name: str) -> int:
+        """Heap + inflight only — excludes the inadmissible parking lot
+        (workloads there wait on cluster events, not cycles)."""
+        with self._lock:
+            q = self._mgr.cluster_queues.get(cq_name)
+            return q.pending_active() if q else 0
+
     def pending_workloads_info(self, cq_name: str) -> list[Info]:
         """Sorted pending list for the visibility API (reference
         pkg/visibility pending_workloads_cq.go)."""

@@ -124,12 +124,13 @@ class Scheduler:
     # One cycle — reference scheduler.go:176
     # ------------------------------------------------------------------
 
-    def schedule(self) -> CycleStats:
+    def schedule(self, heads: Optional[list[Info]] = None) -> CycleStats:
         self.scheduling_cycle += 1
         stats = CycleStats(cycle=self.scheduling_cycle)
         start = self.clock()
 
-        heads = self.queues.heads_nonblocking()
+        if heads is None:
+            heads = self.queues.heads_nonblocking()
         if not heads:
             return stats
         snapshot = self.cache.snapshot()
@@ -206,6 +207,36 @@ class Scheduler:
                     stats.inadmissible.append(e.info.key)
         stats.duration_s = self.clock() - start
         return stats
+
+    # ------------------------------------------------------------------
+    # Daemon loop — reference scheduler.go:143 Start + util/wait/backoff.go
+    # ------------------------------------------------------------------
+
+    def run(self, stop_event, heads_timeout: float = 0.2,
+            on_cycle: Optional[Callable[[CycleStats], None]] = None) -> None:
+        """Long-running admission loop: block on ``queues.heads`` until
+        work exists, run a cycle, and pace reruns with the speed-signal
+        backoff — KeepGoing after a successful admission, SlowDown
+        otherwise (scheduler.go:176,299-301).
+
+        Returns when ``stop_event`` is set or the queue manager stops.
+        ``heads_timeout`` bounds each blocking wait so stop is honored
+        promptly even with an empty queue."""
+        from ..wait import until_with_backoff
+
+        def cycle() -> bool:
+            if self.queues.stopped:
+                stop_event.set()
+                return True
+            heads = self.queues.heads(timeout=heads_timeout)
+            if not heads:
+                return True  # nothing pending: heads() blocked, no backoff
+            stats = self.schedule(heads=heads)
+            if on_cycle is not None:
+                on_cycle(stats)
+            return bool(stats.admitted)
+
+        until_with_backoff(cycle, stop_event)
 
     # ------------------------------------------------------------------
     # Nomination — reference scheduler.go:336

@@ -1,0 +1,35 @@
+"""Speed-signal scheduling loop (reference pkg/util/wait/backoff.go:19).
+
+``until_with_backoff`` runs ``f`` until the stop event is set.  ``f``
+returns a speed signal: KEEP_GOING (True) reruns immediately with zero
+backoff; SLOW_DOWN (False) sleeps with exponential backoff from 1 ms
+doubling to a 100 ms cap, reset to zero by the next KEEP_GOING — the
+reference's speedyBackoffManager semantics.
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Callable
+
+KEEP_GOING = True
+SLOW_DOWN = False
+
+INITIAL_BACKOFF_S = 0.001
+MAX_BACKOFF_S = 0.1
+
+
+def until_with_backoff(f: Callable[[], bool], stop: threading.Event) -> None:
+    """Run ``f`` in a loop until ``stop`` is set, applying the
+    speed-signal backoff (UntilWithBackoff, backoff.go:30-44).
+
+    The sleep waits on the stop event, so shutdown interrupts a backoff
+    immediately."""
+    backoff = 0.0
+    while not stop.is_set():
+        if f():
+            backoff = 0.0
+            continue
+        backoff = (INITIAL_BACKOFF_S if backoff == 0.0
+                   else min(backoff * 2.0, MAX_BACKOFF_S))
+        stop.wait(backoff)
