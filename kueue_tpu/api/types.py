@@ -392,6 +392,7 @@ WL_EVICTED = "Evicted"
 WL_PREEMPTED = "Preempted"
 WL_REQUEUED = "Requeued"
 WL_DEACTIVATION_TARGET = "DeactivationTarget"
+WL_PODS_READY = "PodsReady"
 
 # Eviction reasons
 EVICTED_BY_PREEMPTION = "Preempted"

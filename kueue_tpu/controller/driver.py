@@ -94,6 +94,8 @@ class Driver:
                                                 backend=solver_backend)
         self.scheduler.apply_admission = self._apply_admission
         self.scheduler.preemptor.apply_preemption = self._apply_preemption
+        if self.wait_for_pods_ready.enable and self.wait_for_pods_ready.block_admission:
+            self.scheduler.admission_blocked = self.admission_blocked
         # durable store: the CRD-status equivalent
         self.workloads: dict[str, Workload] = {}
         self.priority_classes: dict[str, object] = {}
@@ -388,10 +390,17 @@ class Driver:
 
     def _evict(self, wl: Workload, reason: str, message: str,
                preempted_reason: str | None = None) -> None:
-        from ..workload import set_evicted_condition, set_preempted_condition
+        from ..workload import (set_evicted_condition,
+                                set_pods_ready_condition,
+                                set_preempted_condition)
         now = self.clock()
         cq_name = wl.admission.cluster_queue if wl.admission else ""
         set_evicted_condition(wl, reason, message, now)
+        # eviction stops the pods: a stale PodsReady=True must not exempt
+        # a future readmission from the timeout or open the gate
+        from ..api.types import WL_PODS_READY
+        if WL_PODS_READY in wl.conditions:
+            set_pods_ready_condition(wl, False, now)
         if preempted_reason is not None:
             set_preempted_condition(wl, preempted_reason, message, now)
         # reset admission check states on eviction
@@ -489,10 +498,78 @@ class Driver:
                     f"Exceeded the PodsReady timeout {cfg.timeout_seconds}s")
 
     # ------------------------------------------------------------------
+    # WaitForPodsReady enforcement (reference workload_controller.go:546
+    # timeout countdown; scheduler.go:268-279 blockAdmission)
+    # ------------------------------------------------------------------
+
+    def set_pods_ready(self, key: str, ready: bool) -> None:
+        """Sync a workload's PodsReady condition (the jobframework
+        reconciler calls this from the job's pods_ready()); a transition
+        to ready wakes the scheduler (cache.podsReadyCond broadcast,
+        reference cache.go:214)."""
+        wl = self.workloads.get(key)
+        if wl is None or wl.is_finished:
+            return
+        from ..workload import set_pods_ready_condition
+        changed = set_pods_ready_condition(wl, ready, self.clock())
+        cfg = self.wait_for_pods_ready
+        if changed and ready and cfg.enable and cfg.block_admission:
+            # entries held by the blockAdmission gate parked as
+            # inadmissible — the ready transition unparks and wakes them
+            # (no gate → no held entries → nothing to wake)
+            self.queues.queue_inadmissible_workloads(
+                list(self.queues.cluster_queue_names()))
+            self.queues.broadcast()
+
+    def pods_ready_for_all_admitted(self) -> bool:
+        """reference cache.go:187 PodsReadyForAllAdmittedWorkloads."""
+        from ..api.types import WL_PODS_READY
+        for wl in list(self.workloads.values()):
+            if (wl.is_admitted and wl.is_active and not wl.is_finished
+                    and not wl.condition_true(WL_PODS_READY)):
+                return False
+        return True
+
+    def admission_blocked(self) -> bool:
+        """blockAdmission gate: with WaitForPodsReady blocking enabled,
+        no new admission while any admitted workload lacks PodsReady
+        (reference scheduler.go:268-279; held entries requeue and the
+        PodsReady transition wakes them instead of blocking in-cycle)."""
+        cfg = self.wait_for_pods_ready
+        return (cfg.enable and cfg.block_admission
+                and not self.pods_ready_for_all_admitted())
+
+    def enforce_wait_for_pods_ready(self) -> list[str]:
+        """Automatic PodsReady deadline tracking: evict every admitted
+        workload that exceeded the timeout without reaching PodsReady
+        (reference workload_controller.go:546-595 requeue-after timers).
+        Runs each cycle and on daemon ticks; returns the evicted keys."""
+        cfg = self.wait_for_pods_ready
+        if not cfg.enable or not cfg.timeout_seconds:
+            return []
+        from ..api.types import WL_ADMITTED, WL_PODS_READY
+        now = self.clock()
+        out = []
+        for key, wl in list(self.workloads.items()):
+            if (not wl.is_admitted or wl.is_finished
+                    or wl.condition_true(WL_PODS_READY)):
+                continue
+            adm = wl.conditions.get(WL_ADMITTED)
+            if adm is None:
+                continue
+            if now - adm.last_transition_time >= cfg.timeout_seconds:
+                self.evict_for_pods_ready_timeout(key)
+                out.append(key)
+        return out
+
+    # ------------------------------------------------------------------
     # Run loop
     # ------------------------------------------------------------------
 
     def schedule_once(self):
+        if self.wait_for_pods_ready.enable:
+            self.enforce_wait_for_pods_ready()
+        self.queues.wake_expired_backoffs()
         stats = self.scheduler.schedule()
         self.metrics.admission_attempt(bool(stats.admitted), stats.duration_s)
         return stats
@@ -507,8 +584,14 @@ class Driver:
         def on_cycle(stats):
             self.metrics.admission_attempt(bool(stats.admitted),
                                            stats.duration_s)
+
+        def on_tick():
+            if self.wait_for_pods_ready.enable:
+                self.enforce_wait_for_pods_ready()
+            self.queues.wake_expired_backoffs()
+
         self.scheduler.run(stop_event, heads_timeout=heads_timeout,
-                           on_cycle=on_cycle)
+                           on_cycle=on_cycle, on_tick=on_tick)
 
     def run_until_settled(self, max_cycles: int = 1000):
         """Run cycles until a fixed point: no admissions/preemptions AND the

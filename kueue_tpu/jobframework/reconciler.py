@@ -92,6 +92,12 @@ class JobReconciler:
                        "Not admitted; suspending")
             return
 
+        if wl.is_admitted and not job.is_suspended():
+            # PodsReady condition sync from the running job (reference
+            # workload_controller.go PodsReady handling; feeds the
+            # WaitForPodsReady blockAdmission gate + timeout countdown)
+            driver.set_pods_ready(wl_key, job.pods_ready())
+
         if isinstance(job, JobWithReclaimablePods) and wl.has_quota_reservation:
             rp = job.reclaimable_pods()
             if rp:

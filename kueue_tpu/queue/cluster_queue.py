@@ -117,6 +117,29 @@ class ClusterQueueQueue:
         self.inadmissible[key] = info
         return True
 
+    def wake_expired_backoffs(self) -> bool:
+        """Unpark workloads whose requeue backoff just expired — the
+        in-process stand-in for the reference's RequeueAfter timers
+        (workload_controller.go requeues when the backoff fires).  The
+        consumed requeue_at is cleared so the workload isn't re-woken
+        every tick if it parks again."""
+        moved = False
+        still: dict[str, Info] = {}
+        for key, info in self.inadmissible.items():
+            rs = info.obj.requeue_state
+            if (rs is not None and rs.requeue_at is not None
+                    and self.backoff_waiting_time_expired(info)):
+                rs.requeue_at = None   # timer fired
+                # drop from the parking lot even when already in the heap
+                # (mirrors queue_inadmissible_workloads: never track an
+                # entry in both structures)
+                if self.heap.push_if_not_present(info):
+                    moved = True
+                continue
+            still[key] = info
+        self.inadmissible = still
+        return moved
+
     def queue_inadmissible_workloads(self) -> bool:
         """Move the parking lot back into the heap (reference
         cluster_queue.go QueueInadmissibleWorkloads)."""

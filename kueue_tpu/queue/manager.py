@@ -211,6 +211,17 @@ class Manager:
         with self._lock:
             self._cond.notify_all()
 
+    def wake_expired_backoffs(self) -> None:
+        """RequeueAfter-timer equivalent: unpark workloads whose requeue
+        backoff expired (called per cycle and on daemon ticks)."""
+        with self._lock:
+            moved = False
+            for q in self._mgr.cluster_queues.values():
+                if q.wake_expired_backoffs():
+                    moved = True
+            if moved:
+                self._cond.notify_all()
+
     # ------------------------------------------------------------------
     # Heads — reference manager.go:586
     # ------------------------------------------------------------------

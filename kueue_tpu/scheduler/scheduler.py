@@ -115,6 +115,13 @@ class Scheduler:
         # vs the scalar per-entry computeDRS (parity oracle).
         self.fs_batched = True
         self._fs_tracker = None
+        # WaitForPodsReady blockAdmission gate (reference scheduler.go
+        # :268-279): True → hold admissions this cycle.  Evaluated once
+        # at cycle start; held entries requeue with the waiting message
+        # and the PodsReady transition wakes them (instead of the
+        # reference's in-cycle cond wait).
+        self.admission_blocked: Callable[[], bool] = lambda: False
+        self._cycle_blocked = False
         # Optional metrics registry (set by the driver).
         self.metrics = None
         # Namespace → limitrange.Summary (set by the driver).
@@ -133,6 +140,7 @@ class Scheduler:
             heads = self.queues.heads_nonblocking()
         if not heads:
             return stats
+        self._cycle_blocked = self.admission_blocked()
         snapshot = self.cache.snapshot()
         entries = self.nominate(heads, snapshot)
         device_final = self._maybe_solve_on_device(entries, snapshot)
@@ -192,9 +200,21 @@ class Scheduler:
                 stats.preempted_targets.extend(t.info.key for t in e.preemption_targets)
                 continue
 
+            if self._cycle_blocked:
+                # blockAdmission: usage stays consumed for this cycle
+                # (the reference would wait-then-admit here); the entry
+                # requeues and the PodsReady transition wakes it
+                e.inadmissible_msg = ("Waiting for all admitted workloads "
+                                      "to be in the PodsReady condition")
+                continue
             e.status = EntryStatus.NOMINATED
             if self._admit(e, cq):
                 stats.admitted.append(e.info.key)
+                # re-check per admission: the workload just admitted is
+                # itself not PodsReady yet, so with blockAdmission at
+                # most one admission lands per cycle (scheduler.go:268
+                # checks PodsReadyForAllAdmittedWorkloads per entry)
+                self._cycle_blocked = self.admission_blocked()
             else:
                 e.inadmissible_msg = "Failed to admit workload"
 
@@ -213,7 +233,8 @@ class Scheduler:
     # ------------------------------------------------------------------
 
     def run(self, stop_event, heads_timeout: float = 0.2,
-            on_cycle: Optional[Callable[[CycleStats], None]] = None) -> None:
+            on_cycle: Optional[Callable[[CycleStats], None]] = None,
+            on_tick: Optional[Callable[[], object]] = None) -> None:
         """Long-running admission loop: block on ``queues.heads`` until
         work exists, run a cycle, and pace reruns with the speed-signal
         backoff — KeepGoing after a successful admission, SlowDown
@@ -221,13 +242,17 @@ class Scheduler:
 
         Returns when ``stop_event`` is set or the queue manager stops.
         ``heads_timeout`` bounds each blocking wait so stop is honored
-        promptly even with an empty queue."""
+        promptly even with an empty queue.  ``on_tick`` runs every loop
+        iteration, heads or not — deadline enforcement (WaitForPodsReady
+        timeouts) hangs off it."""
         from ..wait import until_with_backoff
 
         def cycle() -> bool:
             if self.queues.stopped:
                 stop_event.set()
                 return True
+            if on_tick is not None:
+                on_tick()
             heads = self.queues.heads(timeout=heads_timeout)
             if not heads:
                 return True  # nothing pending: heads() blocked, no backoff
@@ -476,9 +501,17 @@ class Scheduler:
             e = deferred[wi]
             cq = snapshot.cq(e.info.cluster_queue)
             if final.admitted[wi]:
+                if self._cycle_blocked:
+                    e.inadmissible_msg = (
+                        "Waiting for all admitted workloads to be in the "
+                        "PodsReady condition")
+                    continue
                 e.status = EntryStatus.NOMINATED
                 if self._admit(e, cq):
                     stats.admitted.append(e.info.key)
+                    # per-admission re-check (see host loop): at most one
+                    # not-yet-ready admission per cycle under the gate
+                    self._cycle_blocked = self.admission_blocked()
                 else:
                     e.inadmissible_msg = "Failed to admit workload"
             elif final.preempting is not None and final.preempting[wi]:

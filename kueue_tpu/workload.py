@@ -239,6 +239,22 @@ def sync_admitted_condition(wl: Workload, now: float) -> bool:
     return admitted != was
 
 
+def set_pods_ready_condition(wl: Workload, ready: bool, now: float) -> bool:
+    """PodsReady condition sync (reference workload_controller.go
+    syncs it from the job's PodsReady()).  Returns True on transition."""
+    from .api.types import WL_PODS_READY
+    was = wl.condition_true(WL_PODS_READY)
+    if ready == was and WL_PODS_READY in wl.conditions:
+        return False
+    wl.set_condition(WL_PODS_READY,
+                     ConditionStatus.TRUE if ready else ConditionStatus.FALSE,
+                     reason="PodsReady" if ready else "PodsNotReady",
+                     message=("All pods were ready or succeeded" if ready
+                              else "Not all pods are ready or succeeded"),
+                     now=now)
+    return ready != was
+
+
 def set_evicted_condition(wl: Workload, reason: str, message: str, now: float) -> None:
     """Reference workload.go:637 SetEvictedCondition."""
     wl.set_condition(WL_EVICTED, ConditionStatus.TRUE, reason=reason,
