@@ -274,6 +274,7 @@ class Driver:
         if wl.admission is not None:
             self.cache.delete_workload(Info(wl))
             self.queues.queue_inadmissible_workloads([wl.admission.cluster_queue])
+        self.wake_gate_blocked()   # deleting a not-ready blocker opens the gate
 
     def finish_workload(self, key: str, message: str = "Job finished") -> None:
         """Quota release on completion (reference jobframework finished path)."""
@@ -291,6 +292,7 @@ class Driver:
                 self.metrics.release_admitted(cq_name)
             self.queues.queue_inadmissible_workloads([cq_name])
         self.queues.delete_workload(wl)
+        self.wake_gate_blocked()   # finishing a not-ready blocker opens the gate
 
     def update_reclaimable_pods(self, key: str, counts: dict[str, int]) -> None:
         """reference workload.UpdateReclaimablePods (KEP 78): shrink the
@@ -420,6 +422,7 @@ class Driver:
             self.queues.add_or_update_workload(wl)
         if cq_name:
             self.queues.queue_inadmissible_workloads([cq_name])
+        self.wake_gate_blocked()   # evicting a not-ready blocker opens the gate
 
     def refresh_resource_metrics(self) -> None:
         """Per-CQ resource gauges + LQ mirrors (reference
@@ -507,16 +510,27 @@ class Driver:
         reconciler calls this from the job's pods_ready()); a transition
         to ready wakes the scheduler (cache.podsReadyCond broadcast,
         reference cache.go:214)."""
+        if not self.wait_for_pods_ready.enable:
+            return  # the reference maintains PodsReady only when enabled
         wl = self.workloads.get(key)
         if wl is None or wl.is_finished:
             return
         from ..workload import set_pods_ready_condition
-        changed = set_pods_ready_condition(wl, ready, self.clock())
+        if set_pods_ready_condition(wl, ready, self.clock()) and ready:
+            self.wake_gate_blocked()
+
+    def wake_gate_blocked(self) -> None:
+        """Unpark gate-held entries when the blockAdmission gate opens.
+
+        The gate opens whenever the last admitted-not-ready workload
+        stops being one — pods ready, eviction (incl. the PodsReady
+        timeout), finish, delete, deactivation — and held entries may be
+        parked in ANY cohort, so every gate-opening event must wake all
+        of them (the reference blocks in-cycle instead and has no parked
+        entries to lose, scheduler.go:277)."""
         cfg = self.wait_for_pods_ready
-        if changed and ready and cfg.enable and cfg.block_admission:
-            # entries held by the blockAdmission gate parked as
-            # inadmissible — the ready transition unparks and wakes them
-            # (no gate → no held entries → nothing to wake)
+        if (cfg.enable and cfg.block_admission
+                and self.pods_ready_for_all_admitted()):
             self.queues.queue_inadmissible_workloads(
                 list(self.queues.cluster_queue_names()))
             self.queues.broadcast()

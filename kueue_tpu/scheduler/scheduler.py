@@ -153,6 +153,7 @@ class Scheduler:
                         stats.skipped.append(e.info.key)
                     else:
                         stats.inadmissible.append(e.info.key)
+            self._rewake_if_gate_opened()
             stats.duration_s = self.clock() - start
             return stats
         iterator = self._make_iterator(entries, snapshot)
@@ -225,8 +226,20 @@ class Scheduler:
                     stats.skipped.append(e.info.key)
                 else:
                     stats.inadmissible.append(e.info.key)
+        self._rewake_if_gate_opened()
         stats.duration_s = self.clock() - start
         return stats
+
+    def _rewake_if_gate_opened(self) -> None:
+        """Close the missed-wakeup race on the blockAdmission gate: the
+        gate was sampled at cycle start, but a concurrent PodsReady
+        transition may have fired its wake BEFORE this cycle parked the
+        held entries.  If the gate is open now, re-wake what we just
+        parked."""
+        if self._cycle_blocked and not self.admission_blocked():
+            self.queues.queue_inadmissible_workloads(
+                list(self.queues.cluster_queue_names()))
+            self.queues.broadcast()
 
     # ------------------------------------------------------------------
     # Daemon loop — reference scheduler.go:143 Start + util/wait/backoff.go

@@ -201,7 +201,48 @@ def test_pods_ready_backoff_limit_deactivates():
         m.sync()
     wl = d.workload(key)
     assert not wl.is_active, wl.conditions   # deactivated, not requeued
-    assert wl.requeue_state.count == 2 or not wl.is_active
+    assert wl.requeue_state.count == 2, wl.requeue_state
+
+
+def test_gate_opens_across_cohorts_on_blocker_eviction():
+    """A gate-held workload parked in cohort Y must wake when the
+    not-ready blocker in cohort X is evicted/finished — every
+    gate-opening event wakes all parked entries, not just the blocker's
+    cohort."""
+    cfg = WaitForPodsReadyConfig(enable=True, block_admission=True,
+                                 timeout_seconds=10,
+                                 requeuing_backoff_base_seconds=1)
+    clock = FakeClock()
+    d = Driver(clock=clock, wait_for_pods_ready=cfg)
+    d.apply_resource_flavor(ResourceFlavor(name="default"))
+    for i, cohort in enumerate(["x", "y"]):
+        d.apply_cluster_queue(ClusterQueue(
+            name=f"cq-{i}", cohort=cohort,
+            resource_groups=[ResourceGroup(
+                covered_resources=["cpu"],
+                flavors=[FlavorQuotas(name="default", resources={
+                    "cpu": ResourceQuota(nominal=4000)})])]))
+        d.apply_local_queue(LocalQueue(name=f"lq-{i}",
+                                       cluster_queue=f"cq-{i}"))
+    m = JobManager(d)
+    blocker = SlowStartJob("blocker", parallelism=1, requests={"cpu": 1000},
+                           queue="lq-0")
+    m.upsert(blocker)
+    d.schedule_once()
+    m.sync()                       # blocker admitted, never ready
+    held = SlowStartJob("held", parallelism=1, requests={"cpu": 1000},
+                        queue="lq-1")
+    m.upsert(held)
+    stats = d.schedule_once()
+    assert not stats.admitted      # gate closed; held parks in cohort y
+    clock.t += 11.0                # blocker times out and is evicted
+    # the eviction opens the gate and unparks cohort-y's held entry in
+    # the same schedule_once — no unrelated cluster event needed
+    stats = d.schedule_once()
+    key_b = m.reconciler.workload_key_for(blocker)
+    assert d.workload(key_b).condition_true(WL_EVICTED)
+    key_h = m.reconciler.workload_key_for(held)
+    assert key_h in stats.admitted, stats
 
 
 def test_daemon_tick_enforces_timeout_without_cycles():
