@@ -126,14 +126,16 @@ class TASFlavorSnapshot:
         """
         if not self.levels:
             return None, "no topology levels"
-        self._fill_in_counts(per_pod, assumed)
-
         required_idx = self._level_index(request.required)
         preferred_idx = self._level_index(request.preferred)
         if request.required and required_idx is None:
             return None, f"level {request.required} not in topology"
         if request.preferred and preferred_idx is None:
             return None, f"level {request.preferred} not in topology"
+        if self._device_kernel_eligible(request):
+            return self._find_device(count, per_pod, request, assumed,
+                                     required_idx, preferred_idx)
+        self._fill_in_counts(per_pod, assumed)
 
         if request.unconstrained:
             # any set of leaves; minimize domain count from the top
@@ -165,6 +167,116 @@ class TASFlavorSnapshot:
                     return self._assignment_from(chosen), ""
             chosen = {domain: count}
         return self._assignment_from(chosen), ""
+
+    # -- device kernel path (ops/tas_kernel, TASDeviceKernel gate) -----
+
+    def _device_kernel_eligible(self, request: PodSetTopologyRequest) -> bool:
+        """The batched kernel implements the default BestFit profile;
+        the TASProfile* gates (including Mixed's unconstrained variant)
+        keep the scalar tree walk."""
+        from .. import features
+        unconstrained = bool(request.unconstrained)
+        return (features.enabled("TASDeviceKernel")
+                and self._use_best_fit(unconstrained)
+                and not self._use_least_free(unconstrained)
+                and bool(self.leaves))
+
+    def _find_device(self, count: int, per_pod: dict[str, int],
+                     request: PodSetTopologyRequest,
+                     assumed: dict[tuple, dict[str, int]] | None,
+                     required_idx: Optional[int],
+                     preferred_idx: Optional[int],
+                     ) -> tuple[Optional[TopologyAssignment], str]:
+        """find_topology_assignment on the batched kernel
+        (ops/tas_kernel: segment reductions over level-CSR arrays),
+        decision-identical to the scalar walk (tests/test_tas_kernel.py
+        + test_tas_device_path)."""
+        import numpy as np
+        from ..ops import tas_kernel as tk
+
+        packed = getattr(self, "_packed_tas", None)
+        if packed is None:
+            packed = self._packed_tas = tk.pack_tas(self)
+        sizes = tuple(packed.level_sizes)
+        parents = tuple(packed.parents)
+        r_index = {r: i for i, r in enumerate(packed.resource_names)}
+
+        per_pod_vec = np.zeros(max(1, len(packed.resource_names)),
+                               dtype=np.int32)
+        unknown_requested = False
+        for r, v in per_pod.items():
+            if v <= 0:
+                continue
+            ri = r_index.get(r)
+            if ri is None:
+                unknown_requested = True  # no leaf has it: states all 0
+            else:
+                per_pod_vec[ri] = v
+        leaf_free = packed.leaf_free
+        if assumed:
+            leaf_free = leaf_free.copy()
+            for i, did in enumerate(packed.leaf_ids):
+                a = assumed.get(did)
+                if a:
+                    for r, v in a.items():
+                        ri = r_index.get(r)
+                        if ri is not None:
+                            leaf_free[i, ri] = max(0, leaf_free[i, ri] - v)
+        if unknown_requested:
+            leaf_free = np.zeros_like(packed.leaf_free)
+
+        def level_states(level: int) -> np.ndarray:
+            states = tk.fill_counts(leaf_free, per_pod_vec, parents,
+                                    level_sizes=sizes)
+            return np.asarray(states[level])
+
+        def total_fit() -> int:
+            return int(level_states(0).sum())
+
+        def finish(leaf_counts) -> TopologyAssignment:
+            domains = [TopologyDomainAssignment(values=list(did),
+                                                count=int(c))
+                       for did, c in sorted(
+                           (packed.leaf_ids[i], int(c))
+                           for i, c in enumerate(np.asarray(leaf_counts))
+                           if c)]
+            return TopologyAssignment(levels=list(self.levels),
+                                      domains=domains)
+
+        if request.unconstrained:
+            ok, counts = tk.split_across_roots(
+                leaf_free, per_pod_vec, parents, count, level_sizes=sizes)
+            if not bool(ok):
+                return None, self._fit_message(count, total_fit())
+            return finish(counts), ""
+
+        if required_idx is not None:
+            ok, counts = tk.best_fit_descend(
+                leaf_free, per_pod_vec, parents, count,
+                level_sizes=sizes, level=required_idx)
+            if not bool(ok):
+                # host message reads Domain.state, unfilled on this path:
+                # compute the best single-domain fit from kernel states
+                best = int(level_states(required_idx).max(initial=0))
+                return None, (
+                    f"topology {self.flavor!r} allows to fit only {best} "
+                    f"out of {count} pod(s) in a single "
+                    f"{self.levels[required_idx]!r}")
+            return finish(counts), ""
+
+        start = (preferred_idx if preferred_idx is not None
+                 else len(self.levels) - 1)
+        for lvl in range(start, -1, -1):
+            ok, counts = tk.best_fit_descend(
+                leaf_free, per_pod_vec, parents, count,
+                level_sizes=sizes, level=lvl)
+            if bool(ok):
+                return finish(counts), ""
+        ok, counts = tk.split_across_roots(
+            leaf_free, per_pod_vec, parents, count, level_sizes=sizes)
+        if not bool(ok):
+            return None, self._fit_message(count, total_fit())
+        return finish(counts), ""
 
     # -- helpers --
 

@@ -529,8 +529,12 @@ class Driver:
         of them (the reference blocks in-cycle instead and has no parked
         entries to lose, scheduler.go:277)."""
         cfg = self.wait_for_pods_ready
-        if (cfg.enable and cfg.block_admission
-                and self.pods_ready_for_all_admitted()):
+        if not (cfg.enable and cfg.block_admission):
+            return
+        if not self.scheduler.gate_parked:
+            return  # the gate never held anything: nothing to wake
+        if self.pods_ready_for_all_admitted():
+            self.scheduler.gate_parked = False
             self.queues.queue_inadmissible_workloads(
                 list(self.queues.cluster_queue_names()))
             self.queues.broadcast()

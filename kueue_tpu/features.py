@@ -45,6 +45,9 @@ DEFAULT_FEATURE_GATES: dict[str, FeatureSpec] = {
     "TASProfileMostFreeCapacity": FeatureSpec(False, "Alpha"),
     "TASProfileLeastFreeCapacity": FeatureSpec(False, "Alpha"),
     "TASProfileMixed": FeatureSpec(False, "Alpha"),
+    # kueue-tpu extension: route find_topology_assignment through the
+    # batched ops/tas_kernel (default BestFit profile only)
+    "TASDeviceKernel": FeatureSpec(False, "Alpha"),
 }
 
 _overrides: dict[str, bool] = {}

@@ -122,6 +122,9 @@ class Scheduler:
         # reference's in-cycle cond wait).
         self.admission_blocked: Callable[[], bool] = lambda: False
         self._cycle_blocked = False
+        # True while entries the gate held are parked somewhere —
+        # gate-opening events only need to wake when this is set
+        self.gate_parked = False
         # Optional metrics registry (set by the driver).
         self.metrics = None
         # Namespace → limitrange.Summary (set by the driver).
@@ -207,6 +210,7 @@ class Scheduler:
                 # requeues and the PodsReady transition wakes it
                 e.inadmissible_msg = ("Waiting for all admitted workloads "
                                       "to be in the PodsReady condition")
+                self.gate_parked = True
                 continue
             e.status = EntryStatus.NOMINATED
             if self._admit(e, cq):
@@ -237,6 +241,7 @@ class Scheduler:
         held entries.  If the gate is open now, re-wake what we just
         parked."""
         if self._cycle_blocked and not self.admission_blocked():
+            self.gate_parked = False
             self.queues.queue_inadmissible_workloads(
                 list(self.queues.cluster_queue_names()))
             self.queues.broadcast()
@@ -518,6 +523,7 @@ class Scheduler:
                     e.inadmissible_msg = (
                         "Waiting for all admitted workloads to be in the "
                         "PodsReady condition")
+                    self.gate_parked = True
                     continue
                 e.status = EntryStatus.NOMINATED
                 if self._admit(e, cq):
