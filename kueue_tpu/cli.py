@@ -306,8 +306,10 @@ def _set_stop_policy(store: Store, args, policy: StopPolicy) -> int:
 
 
 def cmd_schedule(store: Store, args) -> int:
+    from .profiling import trace
     driver = build_driver(store)
-    driver.run_until_settled(max_cycles=args.cycles)
+    with trace(getattr(args, "profile_dir", None)):
+        driver.run_until_settled(max_cycles=args.cycles)
     save_workloads(store, driver)
     store.save()
     admitted = sorted(driver.admitted_keys())
@@ -413,10 +415,17 @@ def cmd_serve(store: Store, args) -> int:
                     stop.set()
         threading.Thread(target=drain_check, daemon=True).start()
 
+    profile_dir = getattr(args, "profile_dir", None)
+    if profile_dir:
+        from .profiling import start_trace
+        start_trace(profile_dir)
     print(f"serving from {args.state_dir} (SIGUSR2 dumps state, "
           f"SIGTERM stops)", flush=True)
     try:
         driver.run(stop)                     # blocks until stop
+        if profile_dir:
+            from .profiling import stop_trace
+            stop_trace()                     # may raise: lease still freed
         # status write-back against a FRESH store read: spec edits made
         # by other processes while serving are preserved, and workloads
         # deleted from the store stay deleted
@@ -518,6 +527,8 @@ def main(argv: list[str] | None = None) -> int:
 
     p = sub.add_parser("schedule", help="run admission cycles")
     p.add_argument("--cycles", type=int, default=1000)
+    p.add_argument("--profile-dir", default=None,
+                   help="write a jax.profiler trace here")
 
     sub.add_parser("state", help="dump queues/cache state")
 
@@ -526,6 +537,8 @@ def main(argv: list[str] | None = None) -> int:
                    help="store-watch poll interval (seconds)")
     p.add_argument("--exit-when-drained", action="store_true",
                    help="exit once no workloads are pending (tests)")
+    p.add_argument("--profile-dir", default=None,
+                   help="write a jax.profiler trace here")
 
     p = sub.add_parser("import", help="bulk-import running pods")
     p.add_argument("-f", "--filename", required=True)

@@ -28,7 +28,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
-from .quota_kernel import available_all, add_usage_chain
+from .quota_kernel import available_all, available_at, add_usage_chain
 
 
 # ----------------------------------------------------------------------
@@ -150,9 +150,9 @@ def cycle_order_np(borrows, priority, timestamp) -> np.ndarray:
 # Device admit scan (fixed assignments; the production phase 2)
 # ----------------------------------------------------------------------
 
-def _entry_decision(avail, usage, wi, valid, *, nominal_cq, npb_cq, wl_cq,
-                    dec_fr, dec_amt, fit_mask, res_fr, res_amt, res_mask,
-                    res_borrows):
+def _entry_decision(avail_row, usage, wi, valid, *, nominal_cq, npb_cq,
+                    wl_cq, dec_fr, dec_amt, fit_mask, res_fr, res_amt,
+                    res_mask, res_borrows):
     """The per-entry decision shared by admit_scan and admit_scan_forests:
     fixed-assignment fit re-check (scheduler.go:372, Fits over
     assignment.Usage) or capacity reserve (resourcesToReserve,
@@ -172,7 +172,7 @@ def _entry_decision(avail, usage, wi, valid, *, nominal_cq, npb_cq, wl_cq,
     amt = dec_amt[wis]
     frs_safe = jnp.maximum(frs, 0)
     relevant = frs >= 0
-    ok = jnp.all(jnp.where(relevant, amt <= avail[cq][frs_safe], True))
+    ok = jnp.all(jnp.where(relevant, amt <= avail_row[frs_safe], True))
     admit = fit_mask[wis] & valid & ok
     delta_f = jnp.zeros(F, dtype=usage.dtype).at[frs_safe].add(
         jnp.where(relevant & admit, amt, 0))
@@ -196,11 +196,16 @@ def _entry_decision(avail, usage, wi, valid, *, nominal_cq, npb_cq, wl_cq,
 def _admit_step(usage, wi, *, subtree, guaranteed, borrow_cap, has_blim,
                 parent, nominal_cq, npb_cq, wl_cq, dec_fr, dec_amt,
                 fit_mask, res_fr, res_amt, res_mask, res_borrows, depth):
-    """One cycle-order step: fit re-check + admit, or capacity reserve."""
-    avail = available_all(usage, subtree, guaranteed, borrow_cap,
-                          has_blim, parent, depth)
+    """One cycle-order step: fit re-check + admit, or capacity reserve.
+
+    Availability is computed chain-locally for the entry's CQ only
+    (O(depth·F) per step, not O(N·F)) — the fits re-check never looks at
+    another CQ's row."""
+    cq = jnp.maximum(wl_cq[jnp.maximum(wi, 0)], 0)
+    avail_row = available_at(usage, subtree, guaranteed, borrow_cap,
+                             has_blim, parent, cq, depth)
     admit, node, delta_f = _entry_decision(
-        avail, usage, wi, wl_cq[wi] >= 0,
+        avail_row, usage, wi, wl_cq[wi] >= 0,
         nominal_cq=nominal_cq, npb_cq=npb_cq, wl_cq=wl_cq,
         dec_fr=dec_fr, dec_amt=dec_amt, fit_mask=fit_mask,
         res_fr=res_fr, res_amt=res_amt, res_mask=res_mask,
@@ -253,7 +258,7 @@ def _remove_usage_chain(usage, node, delta, guaranteed, parent, depth):
     return usage
 
 
-def _preempt_entry_decision(avail_check, usage, usage_check, used, wi, valid,
+def _preempt_entry_decision(usage, usage_check, used, wi, valid,
                             *, nominal_cq, npb_cq, wl_cq, dec_fr, dec_amt,
                             fit_mask, res_fr, res_amt, res_mask,
                             res_borrows, preempt_mask, pre_fr, pre_amt,
@@ -277,12 +282,15 @@ def _preempt_entry_decision(avail_check, usage, usage_check, used, wi, valid,
     F = usage.shape[1]
     MT = tgt_mat.shape[1]
 
-    # --- fit entry: re-check the fixed pairs against avail_check ---
+    # --- fit entry: re-check the fixed pairs against the check state
+    # (chain-local availability at the entry's CQ only) ---
+    avail_check = available_at(usage_check, subtree, guaranteed, borrow_cap,
+                               has_blim, parent, cq, depth)
     frs = dec_fr[wis]
     amt = dec_amt[wis]
     frs_safe = jnp.maximum(frs, 0)
     relevant = frs >= 0
-    fit_ok = jnp.all(jnp.where(relevant, amt <= avail_check[cq][frs_safe],
+    fit_ok = jnp.all(jnp.where(relevant, amt <= avail_check[frs_safe],
                                True))
     admit = fit_mask[wis] & valid & fit_ok
     delta_f = jnp.zeros(F, dtype=usage.dtype).at[frs_safe].add(
@@ -304,13 +312,13 @@ def _preempt_entry_decision(avail_check, usage, usage_check, used, wi, valid,
         return jnp.where(do, u2, u)
 
     u_try = jax.lax.fori_loop(0, MT, rm, usage_check)
-    avail_try = available_all(u_try, subtree, guaranteed, borrow_cap,
-                              has_blim, parent, depth)
+    avail_try = available_at(u_try, subtree, guaranteed, borrow_cap,
+                             has_blim, parent, cq, depth)
     pfrs = pre_fr[wis]
     pamt = pre_amt[wis]
     pfrs_safe = jnp.maximum(pfrs, 0)
     p_rel = pfrs >= 0
-    pre_ok = jnp.all(jnp.where(p_rel, pamt <= avail_try[cq][pfrs_safe], True))
+    pre_ok = jnp.all(jnp.where(p_rel, pamt <= avail_try[pfrs_safe], True))
     preempting = act_pre & pre_ok
     pre_delta = jnp.zeros(F, dtype=usage.dtype).at[pfrs_safe].add(
         jnp.where(p_rel & preempting, pamt, 0))
@@ -355,11 +363,9 @@ def admit_scan_preempt(usage0, subtree, guaranteed, borrow_cap, has_blim,
 
     def step(carry, wi):
         usage, usage_check, used = carry
-        avail_check = available_all(usage_check, subtree, guaranteed,
-                                    borrow_cap, has_blim, parent, depth)
         admit, preempting, overlap_skip, node, delta_f, u_try, used = (
             _preempt_entry_decision(
-                avail_check, usage, usage_check, used, wi, wl_cq[wi] >= 0,
+                usage, usage_check, used, wi, wl_cq[wi] >= 0,
                 nominal_cq=nominal_cq, npb_cq=npb_cq, wl_cq=wl_cq,
                 dec_fr=dec_fr, dec_amt=dec_amt, fit_mask=fit_mask,
                 res_fr=res_fr, res_amt=res_amt, res_mask=res_mask,
@@ -546,16 +552,21 @@ def admit_scan_forests(usage0, subtree, guaranteed, borrow_cap, has_blim,
 
     def step(usage, col):
         wis = mat[:, col]                                # [G]
-        avail = available_all(usage, subtree, guaranteed, borrow_cap,
-                              has_blim, parent, depth)
-        admit, nodes, deltas = jax.vmap(
-            lambda wi: _entry_decision(
-                avail, usage, wi,
+
+        def entry(wi):
+            cq = jnp.maximum(wl_cq[jnp.maximum(wi, 0)], 0)
+            avail_row = available_at(usage, subtree, guaranteed,
+                                     borrow_cap, has_blim, parent, cq,
+                                     depth)
+            return _entry_decision(
+                avail_row, usage, wi,
                 (wi >= 0) & (wl_cq[jnp.maximum(wi, 0)] >= 0),
                 nominal_cq=nominal_cq, npb_cq=npb_cq,
                 wl_cq=wl_cq, dec_fr=dec_fr, dec_amt=dec_amt,
                 fit_mask=fit_mask, res_fr=res_fr, res_amt=res_amt,
-                res_mask=res_mask, res_borrows=res_borrows))(wis)
+                res_mask=res_mask, res_borrows=res_borrows)
+
+        admit, nodes, deltas = jax.vmap(entry)(wis)
         usage = add_usage_chain_batched(usage, nodes, deltas, guaranteed,
                                         parent, depth)
         return usage, (wis, admit)

@@ -23,7 +23,7 @@ from functools import partial
 import jax
 import jax.numpy as jnp
 
-from .quota_kernel import available_all, add_usage_chain
+from .quota_kernel import available_at, add_usage_chain
 
 
 def remove_usage_chain(usage, node, delta, guaranteed, parent, depth):
@@ -48,12 +48,11 @@ def remove_usage_chain(usage, node, delta, guaranteed, parent, depth):
     return usage
 
 
-@partial(jax.jit, static_argnames=("depth",))
-def minimal_preemptions(usage0, subtree, guaranteed, borrow_cap, has_blim,
-                        parent, preemptor_cq, wl_usage, frs_mask,
-                        cand_cq, cand_delta, cand_other_cq,
-                        cand_above_threshold, allow_borrowing0,
-                        threshold_enabled, *, depth: int):
+def _minimal_preemptions_core(usage0, subtree, guaranteed, borrow_cap,
+                              has_blim, parent, preemptor_cq, wl_usage,
+                              frs_mask, cand_cq, cand_delta, cand_other_cq,
+                              cand_above_threshold, allow_borrowing0,
+                              threshold_enabled, depth: int):
     """Returns (fitted bool, target_mask [K] bool).
 
     wl_usage/cand_delta are in packed-F space (scaled ints); frs_mask
@@ -62,9 +61,10 @@ def minimal_preemptions(usage0, subtree, guaranteed, borrow_cap, has_blim,
     K = cand_cq.shape[0]
 
     def fits(usage, allow_borrowing):
-        """workloadFits (preemption.go:552)."""
-        avail = available_all(usage, subtree, guaranteed, borrow_cap,
-                              has_blim, parent, depth)[preemptor_cq]
+        """workloadFits (preemption.go:552) — availability chain-local
+        to the preemptor's CQ (O(depth·F) per candidate step)."""
+        avail = available_at(usage, subtree, guaranteed, borrow_cap,
+                             has_blim, parent, preemptor_cq, depth)
         relevant = wl_usage > 0
         ok = jnp.all(jnp.where(relevant, wl_usage <= avail, True))
         borrowing = jnp.any(jnp.where(
@@ -115,3 +115,44 @@ def minimal_preemptions(usage0, subtree, guaranteed, borrow_cap, has_blim,
 
     target_mask = removed & ~filled_back & fitted
     return fitted, target_mask
+
+
+@partial(jax.jit, static_argnames=("depth",))
+def minimal_preemptions(usage0, subtree, guaranteed, borrow_cap, has_blim,
+                        parent, preemptor_cq, wl_usage, frs_mask,
+                        cand_cq, cand_delta, cand_other_cq,
+                        cand_above_threshold, allow_borrowing0,
+                        threshold_enabled, *, depth: int):
+    """One search (see _minimal_preemptions_core)."""
+    return _minimal_preemptions_core(
+        usage0, subtree, guaranteed, borrow_cap, has_blim, parent,
+        preemptor_cq, wl_usage, frs_mask, cand_cq, cand_delta,
+        cand_other_cq, cand_above_threshold, allow_borrowing0,
+        threshold_enabled, depth)
+
+
+@partial(jax.jit, static_argnames=("depth",))
+def minimal_preemptions_batch(usage0, subtree, guaranteed, borrow_cap,
+                              has_blim, parent, pre_cq, wl_usage, frs_mask,
+                              cand_cq, cand_delta, cand_other_cq,
+                              cand_above_threshold, allow_borrowing0,
+                              threshold_enabled, *, depth: int):
+    """ALL of a cycle's preemption searches in ONE dispatch.
+
+    Every search runs against the same snapshot usage (the reference
+    computes each preempt head's targets independently at nominate),
+    so the searches vmap cleanly over a leading S axis: pre_cq [S],
+    wl_usage/frs_mask [S, F], cand_* [S, K], flags [S].  Returns
+    (fitted [S], target_mask [S, K]).  Padded rows (pre_cq = -1 or all
+    cand_cq = -1) come back unfitted."""
+    def one(pcq, wu, fm, cc, cd, co, ca, ab, te):
+        return _minimal_preemptions_core(
+            usage0, subtree, guaranteed, borrow_cap, has_blim, parent,
+            jnp.maximum(pcq, 0), wu, fm, cc, cd, co, ca, ab, te, depth)
+
+    fitted, mask = jax.vmap(one)(pre_cq, wl_usage, frs_mask, cand_cq,
+                                 cand_delta, cand_other_cq,
+                                 cand_above_threshold, allow_borrowing0,
+                                 threshold_enabled)
+    valid = pre_cq >= 0
+    return fitted & valid, mask & valid[:, None]

@@ -49,6 +49,117 @@ def _bucket(n: int, minimum: int = 8) -> int:
     return b
 
 
+def device_minimal_preemptions_batch(specs, packed):
+    """ALL of a cycle's preemption searches in one vmapped dispatch.
+
+    ``specs``: [(ctx, candidates, allow_borrowing, threshold)] — the
+    per-head search requests the preemptor planned (every search is
+    against the same nominate-time snapshot, so they are independent).
+    Returns a list of per-spec Target lists ([] = search failed), or
+    None when any spec can't be packed (caller runs the host path)."""
+    from ..scheduler.preemption import Target  # circular-safe import
+
+    if packed is None or not packed.exact or not specs:
+        return None
+    cq_idx = {n: i for i, n in enumerate(packed.cq_names)}
+    F = packed.usage0.shape[1]
+    scale_of = {r: int(packed.resource_scale[i])
+                for i, r in enumerate(packed.resource_names)}
+
+    def to_f_vec(frq) -> Optional[np.ndarray]:
+        vec = np.zeros(F, dtype=np.int64)
+        for fr, v in frq.items():
+            fi = packed.fr_index.get(fr)
+            if fi is None:
+                return None
+            s = scale_of[fr.resource]
+            if v % s:
+                return None
+            vec[fi] += v // s
+        if vec.max(initial=0) > 2**31 - 1:
+            return None
+        return vec.astype(np.int32)
+
+    # generous bucket floors: each distinct (S, K) combination is one
+    # XLA compilation — keep the variety low across a run's cycles
+    S = _bucket(len(specs), minimum=32)
+    K = _bucket(max(1, max(len(c) for _, c, _, _ in specs)), minimum=16)
+    pre_cq = np.full(S, -1, dtype=np.int32)
+    wl_usage = np.zeros((S, F), dtype=np.int32)
+    frs_mask = np.zeros((S, F), dtype=bool)
+    cand_cq = np.full((S, K), -1, dtype=np.int32)
+    cand_delta = np.zeros((S, K, F), dtype=np.int32)
+    cand_other = np.zeros((S, K), dtype=bool)
+    cand_above = np.zeros((S, K), dtype=bool)
+    allow_b0 = np.zeros(S, dtype=bool)
+    thr_en = np.zeros(S, dtype=bool)
+    # target-usage vectors dedupe across specs (the same admitted
+    # workload is a candidate for many preemptors)
+    vec_cache: dict[str, Optional[np.ndarray]] = {}
+
+    for si, (ctx, candidates, allow_borrowing, threshold) in enumerate(specs):
+        ci = cq_idx.get(ctx.preemptor_cq.name)
+        if ci is None:
+            return None
+        wu = to_f_vec(ctx.workload_usage)
+        if wu is None:
+            return None
+        pre_cq[si] = ci
+        wl_usage[si] = wu
+        for fr in ctx.frs_need_preemption:
+            fi = packed.fr_index.get(fr)
+            if fi is None:
+                return None
+            frs_mask[si, fi] = True
+        allow_b0[si] = allow_borrowing
+        thr_en[si] = threshold is not None
+        for k, cand in enumerate(candidates):
+            cci = cq_idx.get(cand.cluster_queue)
+            if cci is None:
+                return None
+            delta = vec_cache.get(cand.key)
+            if delta is None and cand.key not in vec_cache:
+                delta = to_f_vec(cand.usage())
+                vec_cache[cand.key] = delta
+            if delta is None:
+                return None
+            cand_cq[si, k] = cci
+            cand_delta[si, k] = delta
+            cand_other[si, k] = cand.cluster_queue != ctx.preemptor_cq.name
+            cand_above[si, k] = (threshold is not None
+                                 and cand.obj.priority >= threshold)
+
+    import jax
+    from .preemption_kernel import minimal_preemptions_batch
+    with jax.default_device(_cpu_device()):
+        fitted, mask = minimal_preemptions_batch(
+            packed.usage0, packed.subtree_quota, packed.guaranteed,
+            packed.borrow_cap, packed.has_borrow_limit, packed.parent,
+            pre_cq, wl_usage, frs_mask, cand_cq, cand_delta, cand_other,
+            cand_above, allow_b0, thr_en, depth=packed.depth)
+    fitted = np.asarray(fitted)
+    mask = np.asarray(mask)
+
+    out = []
+    for si, (ctx, candidates, _, threshold) in enumerate(specs):
+        if not fitted[si]:
+            out.append([])
+            continue
+        targets = []
+        for k, cand in enumerate(candidates):
+            if not mask[si, k]:
+                continue
+            if cand.cluster_queue == ctx.preemptor_cq.name:
+                reason = IN_CLUSTER_QUEUE_REASON
+            elif threshold is not None and cand.obj.priority < threshold:
+                reason = IN_COHORT_RECLAIM_WHILE_BORROWING_REASON
+            else:
+                reason = IN_COHORT_RECLAMATION_REASON
+            targets.append(Target(info=cand, reason=reason))
+        out.append(targets)
+    return out
+
+
 def device_minimal_preemptions(ctx, candidates, allow_borrowing: bool,
                                threshold: Optional[int], packed=None):
     """Device twin of Preemptor._minimal_preemptions.

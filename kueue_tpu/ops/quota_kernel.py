@@ -41,6 +41,45 @@ def available_all(usage: jnp.ndarray, subtree: jnp.ndarray,
     return jax.lax.fori_loop(0, depth, body, avail)
 
 
+def available_at(usage: jnp.ndarray, subtree: jnp.ndarray,
+                 guaranteed: jnp.ndarray, borrow_cap: jnp.ndarray,
+                 has_blim: jnp.ndarray, parent: jnp.ndarray,
+                 node, depth: int) -> jnp.ndarray:
+    """available() for ONE node: gathers only the node's ancestor chain.
+
+    O(depth·F) instead of available_all's O(N·F·depth) — the hot-loop
+    form for scan steps that check a single CQ's availability (the admit
+    scans' fits re-check, the preemption search's workloadFits).  Equals
+    ``available_all(...)[node]``; node = -1 returns zeros (callers mask
+    validity).  Parity: tests/test_solver_parity.py."""
+    node = jnp.asarray(node, dtype=jnp.int32)
+    if usage.shape[0] <= 64:
+        # small forests: the dense recurrence beats per-level gathers
+        # (shape is static — this branch resolves at trace time)
+        full = available_all(usage, subtree, guaranteed, borrow_cap,
+                             has_blim, parent, depth)
+        return full[jnp.maximum(node, 0)] * (node >= 0)
+    chain = [node]
+    for _ in range(depth - 1):
+        prev = chain[-1]
+        chain.append(jnp.where(prev >= 0,
+                               parent[jnp.maximum(prev, 0)], -1))
+    avail = jnp.zeros(usage.shape[1], dtype=usage.dtype)
+    for i in chain[::-1]:                  # root (topmost valid) → node
+        safe = jnp.maximum(i, 0)
+        valid = i >= 0
+        is_root = parent[safe] < 0
+        u = usage[safe]
+        root_avail = subtree[safe] - u
+        local = jnp.maximum(0, guaranteed[safe] - u)
+        used_in_parent = jnp.maximum(0, u - guaranteed[safe])
+        blim_cap = borrow_cap[safe] - used_in_parent
+        pa = jnp.where(has_blim[safe], jnp.minimum(blim_cap, avail), avail)
+        a = jnp.where(is_root, root_avail, local + pa)
+        avail = jnp.where(valid, a, avail)
+    return avail
+
+
 def add_usage_chain(usage: jnp.ndarray, node: jnp.ndarray, delta: jnp.ndarray,
                     guaranteed: jnp.ndarray, parent: jnp.ndarray,
                     depth: int) -> jnp.ndarray:

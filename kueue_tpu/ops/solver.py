@@ -49,7 +49,7 @@ from ..scheduler.flavorassigner import (
     Mode,
     PodSetAssignmentResult,
 )
-from ..resources import FlavorResource, FlavorResourceQuantities, Requests
+from ..resources import FlavorResource, Requests
 from .packing import (PackedCycle, PackedStructure, _bucket, pack_cycle,
                       pack_structure)
 from .cycle import (admit_scan, admit_scan_forests, admit_scan_preempt,
@@ -741,7 +741,8 @@ class CycleSolver:
                 st.nominal_cq, st.nominal_plus_blimit_cq, packed.wl_cq,
                 dec_fr, dec_amt, fit_mask, res_fr, res_amt, rmask,
                 res_borrows)
-        with jax.default_device(dev):
+        from ..profiling import annotation
+        with annotation(f"admit_scan:{kernel}"), jax.default_device(dev):
             if pmask.any():
                 handle.pending = admit_scan_preempt(
                     *args, pmask, pre_fr, pre_amt,
@@ -888,33 +889,6 @@ class CycleSolver:
                     f"{fr.flavor}, {val - avail} more needed")
         ps.reasons = reasons
         return assignment, assignment.message()
-
-    def preemption_probe(self, cls: ClassifiedCycle, wi: int
-                         ) -> tuple[set, FlavorResourceQuantities]:
-        """(frs_need_preemption, workload_usage) for a preempt head —
-        the inputs candidate discovery needs (preemption.go:466,480)."""
-        h = cls.heads[wi]
-        st = cls.packed.structure
-        cq = cls.snapshot.cq(h.cluster_queue)
-        rg = cq.spec.resource_groups[0]
-        flavor_name = rg.flavors[int(cls.preempt_slot0[wi])].name
-        covers_pods = "pods" in rg.covered_resources
-        res_fit = cls.preempt_res_fit[wi]
-        usage = FlavorResourceQuantities()
-        frs_need = set()
-        for psr in h.total_requests:
-            reqs = dict(psr.requests)
-            if covers_pods:
-                reqs["pods"] = psr.count
-            else:
-                reqs.pop("pods", None)
-            for res, val in reqs.items():
-                fr = FlavorResource(flavor_name, res)
-                usage[fr] = usage.get(fr, 0) + val
-                ri = st.r_index.get(res)
-                if ri is not None and not res_fit[ri]:
-                    frs_need.add(fr)
-        return frs_need, usage
 
     # -- back-compat one-shot API (tests/probes) -----------------------
 

@@ -1,0 +1,76 @@
+"""jax.profiler integration: per-cycle step markers + on-demand traces.
+
+SURVEY §5.1: the reference's observability is zap logging + a pprof flag
+on the perf harness; the TPU-native equivalent is a jax.profiler trace
+with one StepTraceAnnotation per scheduling cycle, so device dispatches
+(admit scans, preemption searches) line up under named cycle steps in
+TensorBoard/Perfetto.
+
+Usage: ``start_trace(logdir)`` / ``stop_trace()`` around any driver
+activity, or ``cli schedule --profile-dir`` / ``cli serve
+--profile-dir`` (traced until SIGTERM).  ``cycle_step`` is a no-op until
+a trace is active.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import threading
+from typing import Optional
+
+_active = threading.Event()
+
+
+def start_trace(logdir: str) -> None:
+    """Begin a jax.profiler trace (host + device activity) to logdir."""
+    import jax
+    jax.profiler.start_trace(logdir)
+    _active.set()
+
+
+def stop_trace() -> None:
+    import jax
+    if _active.is_set():
+        _active.clear()
+        jax.profiler.stop_trace()
+
+
+def trace_active() -> bool:
+    return _active.is_set()
+
+
+@contextlib.contextmanager
+def trace(logdir: Optional[str]):
+    """start_trace/stop_trace as a context; no-op when logdir is None."""
+    if not logdir:
+        yield
+        return
+    start_trace(logdir)
+    try:
+        yield
+    finally:
+        stop_trace()
+
+
+@contextlib.contextmanager
+def cycle_step(cycle: int):
+    """Mark one scheduling cycle as a profiler step (the step markers
+    SURVEY §5.1 names as the TPU equivalent of per-cycle logging)."""
+    if not _active.is_set():
+        yield
+        return
+    import jax
+    with jax.profiler.StepTraceAnnotation("schedule_cycle",
+                                          step_num=cycle):
+        yield
+
+
+@contextlib.contextmanager
+def annotation(name: str):
+    """Named sub-span (nominate / admit-scan / preemption-search)."""
+    if not _active.is_set():
+        yield
+        return
+    import jax
+    with jax.profiler.TraceAnnotation(name):
+        yield

@@ -163,12 +163,31 @@ class Preemptor:
         if self.enable_fair_sharing:
             return self._fair_preemptions(ctx, candidates)
 
+        specs, staged = self.plan_searches(ctx, candidates)
+        cands, ab, thr = specs[0]
+        first = self._minimal_preemptions(ctx, cands, ab, thr)
+        if not staged or first:
+            return first
+        cands, ab, thr = specs[1]  # queue-under-nominal retry
+        return self._minimal_preemptions(ctx, cands, ab, thr)
+
+    def plan_searches(self, ctx: _PreemptionCtx, candidates: list[Info]
+                      ) -> tuple[list[tuple[list[Info], bool, Optional[int]]],
+                                 bool]:
+        """The minimalPreemptions calls _get_targets will issue, computed
+        UPFRONT (every branch condition is snapshot-state only) so a
+        cycle's searches can run as one batched dispatch.
+
+        Returns (specs, staged): specs = [(candidates, allow_borrowing,
+        threshold)]; staged=True → use spec 0's result if it fitted,
+        else spec 1's (the queue-under-nominal retry,
+        preemption.go:144-191)."""
         same_queue = [c for c in candidates
                       if c.cluster_queue == ctx.preemptor_cq.name]
 
         if len(same_queue) == len(candidates):
             # no cross-queue candidates: try borrowing
-            return self._minimal_preemptions(ctx, candidates, True, None)
+            return [(candidates, True, None)], False
 
         borrow_ok, threshold = self._can_borrow_within_cohort(ctx)
         if borrow_ok:
@@ -176,14 +195,70 @@ class Preemptor:
                 candidates = [c for c in candidates
                               if c.cluster_queue == ctx.preemptor_cq.name
                               or c.obj.priority < threshold]
-            return self._minimal_preemptions(ctx, candidates, True, threshold)
+            return [(candidates, True, threshold)], False
 
         if self._queue_under_nominal(ctx):
-            targets = self._minimal_preemptions(ctx, candidates, False, None)
-            if targets:
-                return targets
+            return [(candidates, False, None),
+                    (same_queue, True, None)], True
 
-        return self._minimal_preemptions(ctx, same_queue, True, None)
+        return [(same_queue, True, None)], False
+
+    def get_targets_batch(self, requests: list[tuple[Info, Assignment]],
+                          snapshot: Snapshot) -> list[list[Target]]:
+        """Target searches for ALL of a cycle's preempt heads in one
+        batched device dispatch (ops/preemption_kernel
+        minimal_preemptions_batch) — candidate discovery and ordering
+        stay host-side, the greedy+fillback searches vmap.  Falls back
+        to per-head get_targets for fair sharing, a missing cycle pack,
+        or an unpackable spec (decision-identical either way)."""
+        packed = self._pack_for(snapshot)
+        if (self.enable_fair_sharing or packed is None
+                or self.device_search is False or not requests):
+            return [self.get_targets(wl, a, snapshot) for wl, a in requests]
+
+        flat_specs: list[tuple] = []
+        plans: list[tuple[list[int], bool]] = []
+        for wl, assignment in requests:
+            ctx = _PreemptionCtx(
+                preemptor=wl,
+                preemptor_cq=snapshot.cq(wl.cluster_queue),
+                snapshot=snapshot,
+                frs_need_preemption=flavor_resources_need_preemption(
+                    assignment),
+                workload_usage=assignment.total_requests_for(wl))
+            candidates = self._find_candidates(ctx)
+            if not candidates:
+                plans.append(([], False))
+                continue
+            candidates.sort(key=candidates_ordering_key(
+                ctx.preemptor_cq.name, self.clock()))
+            specs, staged = self.plan_searches(ctx, candidates)
+            idxs = []
+            for cands, ab, thr in specs:
+                idxs.append(len(flat_specs))
+                flat_specs.append((ctx, cands, ab, thr))
+            plans.append((idxs, staged))
+
+        results = None
+        if flat_specs:
+            from ..ops.preemption_solver import (
+                device_minimal_preemptions_batch)
+            results = device_minimal_preemptions_batch(flat_specs, packed)
+            if results is None:
+                # unpackable spec: per-head host path
+                return [self.get_targets(wl, a, snapshot)
+                        for wl, a in requests]
+            self.stats["device_searches"] += len(flat_specs)
+
+        out: list[list[Target]] = []
+        for idxs, staged in plans:
+            if not idxs:
+                out.append([])
+            elif staged and results[idxs[0]]:
+                out.append(results[idxs[0]])
+            else:
+                out.append(results[idxs[-1]])
+        return out
 
     def _can_borrow_within_cohort(self, ctx: _PreemptionCtx
                                   ) -> tuple[bool, Optional[int]]:

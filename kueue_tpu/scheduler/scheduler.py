@@ -143,6 +143,12 @@ class Scheduler:
             heads = self.queues.heads_nonblocking()
         if not heads:
             return stats
+        from ..profiling import cycle_step
+        with cycle_step(self.scheduling_cycle):
+            return self._run_cycle(heads, stats, start)
+
+    def _run_cycle(self, heads: list[Info], stats: CycleStats,
+                   start: float) -> CycleStats:
         self._cycle_blocked = self.admission_blocked()
         snapshot = self.cache.snapshot()
         entries = self.nominate(heads, snapshot)
@@ -397,6 +403,7 @@ class Scheduler:
                 break
 
         if full_ok:
+            batch_reqs: list[tuple[int, Assignment]] = []
             for wi in np.nonzero(cls.preempt0[:n])[0]:
                 wi = int(wi)
                 # With several preempt-capable slots the host walk's choice
@@ -407,29 +414,21 @@ class Scheduler:
                         full_ok = False
                         break
                     continue
-                frs_need, usage = solver.preemption_probe(cls, wi)
-                e = deferred[wi]
-                from .preemption import _PreemptionCtx
-                ctx = _PreemptionCtx(
-                    preemptor=e.info,
-                    preemptor_cq=snapshot.cq(e.info.cluster_queue),
-                    snapshot=snapshot,
-                    frs_need_preemption=frs_need,
-                    workload_usage=usage)
-                if not self.preemptor._find_candidates(ctx):
-                    reserve[wi] = True
-                    continue
-                # preempt head WITH candidates: run the real target search
-                # at nominate (device-backed minimalPreemptions) so the
-                # cycle stays fully device-decided (preemption.go:127-191)
-                assignment = solver.build_preempt_assignment(cls, wi)
-                targets = self.preemptor.get_targets(e.info, assignment,
-                                                     snapshot)
-                if targets:
-                    targets_by_wi[wi] = targets
-                    assignments_by_wi[wi] = assignment
-                else:
-                    reserve[wi] = True
+                batch_reqs.append(
+                    (wi, solver.build_preempt_assignment(cls, wi)))
+            if full_ok and batch_reqs:
+                # all preempt heads' target searches in ONE batched
+                # dispatch (preemption.go:127-191; candidate discovery
+                # host-side, greedy+fillback searches vmapped)
+                results = self.preemptor.get_targets_batch(
+                    [(deferred[wi].info, a) for wi, a in batch_reqs],
+                    snapshot)
+                for (wi, assignment), targets in zip(batch_reqs, results):
+                    if targets:
+                        targets_by_wi[wi] = targets
+                        assignments_by_wi[wi] = assignment
+                    else:
+                        reserve[wi] = True
 
         packed_targets = None
         if full_ok and targets_by_wi:

@@ -79,38 +79,66 @@ def build(n_cqs: int, n_wl: int, use_device: bool, cqs_per_cohort: int = 5,
                     for fi, f in enumerate(flavors)])]))
         d.apply_local_queue(LocalQueue(name=f"lq-{i}",
                                        cluster_queue=f"cq-{i}"))
+    # Ragged pod sets + a low/medium priority mix (the reference perf
+    # generator's class structure, default_generator_config.yaml); the
+    # high-priority preemptor wave is INJECTED mid-run by run_path so
+    # preemption and skips actually fire at scale instead of the
+    # priority order absorbing everything at t0.
     total = 0
     for i in range(n_cqs):
         for k in range(per_cq):
             total += 1
-            cls = k % 3
+            if k % 3 == 2:        # medium: 2500/pod x 2 pods
+                per_pod, count, prio = 2500, 1 + (k % 2), 100
+            else:                 # small: 500/pod x 1..4 pods (ragged)
+                per_pod, count, prio = 500, (1, 2, 4)[k % 3], 50
             d.create_workload(Workload(
                 name=f"wl-{i}-{k}", queue_name=f"lq-{i}",
-                priority=(50, 100, 200)[cls],
-                creation_time=float(total),
-                pod_sets=[PodSet(name="main", count=1,
-                                 requests={r: (1000, 5000, 20000)[cls]
+                priority=prio, creation_time=float(total),
+                pod_sets=[PodSet(name="main", count=count,
+                                 requests={r: per_pod
                                            for r in resources})]))
+
+    def preemptor_wave(start_time: float) -> int:
+        """One large high-priority gang per CQ: 5000/pod x 4 pods fills
+        the whole nominal quota, forcing preemption of the running
+        low-priority wave (reclaimWithinCohort + lowerPriority)."""
+        n = 0
+        for i in range(n_cqs):
+            n += 1
+            d.create_workload(Workload(
+                name=f"pre-{i}", queue_name=f"lq-{i}", priority=200,
+                creation_time=start_time + n,
+                pod_sets=[PodSet(name="main", count=4,
+                                 requests={r: 5000 for r in resources})]))
+        return n
+
     print(f"built {n_cqs} CQs x {len(flavors)} flavors x "
           f"{len(resources)} resources / {total} workloads in "
           f"{time.perf_counter() - t_build:.1f}s", file=sys.stderr)
-    return d, clock, total
+    return d, clock, total, preemptor_wave
 
 
 def run_path(args, use_device: bool) -> dict:
-    d, clock, total = build(args.cqs, args.wl, use_device=use_device,
-                            n_flavors=args.flavors,
-                            n_resources=args.resources)
+    d, clock, total, preemptor_wave = build(
+        args.cqs, args.wl, use_device=use_device,
+        n_flavors=args.flavors, n_resources=args.resources)
     if d.scheduler.solver is not None:
         t_w = time.perf_counter()
         d.scheduler.solver.warmup(d.cache.snapshot(), args.cqs)
         print(f"solver warmup {time.perf_counter() - t_w:.1f}s",
               file=sys.stderr)
 
+    inject_at = args.inject_at if args.inject_at >= 0 else args.cycles // 3
     cycle_times = []
     admitted_total = preempted_total = skipped_total = 0
     running = []
     for cycle in range(args.cycles):
+        if cycle == inject_at:
+            n = preemptor_wave(clock.t)
+            total += n
+            print(f"cycle {cycle}: injected {n} high-priority preemptors",
+                  file=sys.stderr)
         clock.t += 1.0
         c0 = time.perf_counter()
         stats = d.schedule_once()
@@ -133,7 +161,9 @@ def run_path(args, use_device: bool) -> dict:
         running = still
         print(f"cycle {cycle}: {dt*1e3:.1f}ms admitted={len(stats.admitted)} "
               f"preempting={len(stats.preempting)} "
-              f"skipped={len(stats.skipped)}", file=sys.stderr)
+              f"preempted={len(stats.preempted_targets)} "
+              f"skipped={len(stats.skipped)} "
+              f"inadmissible={len(stats.inadmissible)}", file=sys.stderr)
 
     cycle_times.sort()
     p50 = cycle_times[len(cycle_times) // 2]
@@ -166,9 +196,12 @@ def main():
                     help="run ONLY the host path")
     ap.add_argument("--device", action="store_true",
                     help="run ONLY the device path")
-    ap.add_argument("--runtime", type=int, default=2)
+    ap.add_argument("--runtime", type=int, default=4)
     ap.add_argument("--flavors", type=int, default=1)
     ap.add_argument("--resources", type=int, default=1)
+    ap.add_argument("--inject-at", type=int, default=-1,
+                    help="cycle at which the preemptor wave arrives "
+                         "(default cycles//3)")
     args = ap.parse_args()
 
     # default: BOTH paths in one invocation, side by side — the honest
@@ -193,6 +226,9 @@ def main():
         tail["device_beats_host_p99"] = dev["p99_ms"] < host["p99_ms"]
     else:
         tail["value"] = results[0]["p99_ms"]
+    # the artifact must prove the hard paths ran at scale
+    tail["hard_paths_exercised"] = all(
+        r["preempted"] > 0 and r["skipped"] > 0 for r in results)
     print(json.dumps(tail))
 
 

@@ -264,3 +264,50 @@ def test_drs_kernel_matches_host():
         if host_drs > 0:
             borrowing_cqs += 1
     assert borrowing_cqs >= 1, "scenario produced no borrowing CQ"
+
+
+def test_available_at_matches_available_all():
+    """Chain-local availability (quota_kernel.available_at) must equal
+    the full-forest recurrence row-for-row on random forests."""
+    import jax
+    import jax.numpy as jnp
+    from kueue_tpu.ops.quota_kernel import available_all, available_at
+
+    rng = random.Random(5)
+    for trial in range(6):
+        # sizes above AND below the <=64 dense shortcut so the
+        # chain-gather branch gets real coverage
+        N, F = rng.choice([(6, 2), (12, 3), (100, 2), (150, 1)])
+        parent = np.full(N, -1, dtype=np.int32)
+        for i in range(1, N):
+            # forest: some roots, others attach to any earlier node
+            parent[i] = rng.choice([-1, rng.randrange(0, i)])
+        depth = 1
+        for i in range(N):
+            d, p = 1, parent[i]
+            while p >= 0:
+                d += 1
+                p = parent[p]
+            depth = max(depth, d)
+        usage = np.array([[rng.randrange(0, 50) for _ in range(F)]
+                          for _ in range(N)], dtype=np.int32)
+        subtree = np.array([[rng.randrange(0, 80) for _ in range(F)]
+                            for _ in range(N)], dtype=np.int32)
+        guaranteed = np.minimum(
+            subtree, np.array([[rng.randrange(0, 40) for _ in range(F)]
+                               for _ in range(N)], dtype=np.int32))
+        has_blim = np.array([[rng.random() < 0.4 for _ in range(F)]
+                             for _ in range(N)])
+        borrow_cap = np.where(
+            has_blim, np.array([[rng.randrange(0, 60) for _ in range(F)]
+                                for _ in range(N)]), 10**6).astype(np.int32)
+        full = np.asarray(available_all(
+            jnp.asarray(usage), jnp.asarray(subtree), jnp.asarray(guaranteed),
+            jnp.asarray(borrow_cap), jnp.asarray(has_blim),
+            jnp.asarray(parent), depth))
+        for node in range(N):
+            row = np.asarray(available_at(
+                jnp.asarray(usage), jnp.asarray(subtree),
+                jnp.asarray(guaranteed), jnp.asarray(borrow_cap),
+                jnp.asarray(has_blim), jnp.asarray(parent), node, depth))
+            assert np.array_equal(row, full[node]), (trial, node)
