@@ -32,23 +32,56 @@ from ..api.types import (
 MULTIKUEUE_CONTROLLER_NAME = "kueue.x-k8s.io/multikueue"
 
 
+RETRY_BASE_S = 1.0
+RETRY_MAX_S = 60.0
+
+
 @dataclass
 class WorkerCluster:
-    """A remote cluster: a full Driver behind a connection that can drop
-    (reference multikueuecluster.go remoteClient)."""
+    """A remote cluster behind a connection that can drop (reference
+    multikueuecluster.go remoteClient).
+
+    ``driver`` — an in-process Driver (the multi-envtest pattern) — or
+    ``client`` — any transport client (kueue_tpu.remote.HttpWorkerClient
+    for a real process/socket boundary).  Reconnection follows the
+    reference's exponential retry (multikueuecluster.go:67 retryAfter,
+    :134-226 watch re-establishment): a failed operation marks the
+    cluster lost; health probes retry with doubling backoff."""
     name: str
-    driver: object                    # a kueue_tpu Driver
+    driver: object = None             # in-process Driver (optional)
+    client: object = None             # transport client
     active: bool = True
     lost_since: Optional[float] = None
+    next_retry: float = 0.0
+    retry_backoff: float = RETRY_BASE_S
+
+    def __post_init__(self):
+        if self.client is None and self.driver is not None:
+            from ..remote import LocalWorkerClient
+            self.client = LocalWorkerClient(self.driver)
 
     def mark_lost(self, now: float) -> None:
         if self.active:
             self.active = False
             self.lost_since = now
+            self.retry_backoff = RETRY_BASE_S
+            self.next_retry = now + self.retry_backoff
+
+    def try_reconnect(self, now: float) -> bool:
+        """Health-probe with exponential backoff; True on reconnect."""
+        if self.active or now < self.next_retry:
+            return False
+        if self.client.healthy():
+            self.reconnect()
+            return True
+        self.retry_backoff = min(self.retry_backoff * 2.0, RETRY_MAX_S)
+        self.next_retry = now + self.retry_backoff
+        return False
 
     def reconnect(self) -> None:
         self.active = True
         self.lost_since = None
+        self.retry_backoff = RETRY_BASE_S
 
 
 @dataclass
@@ -74,6 +107,10 @@ class MultiKueueController:
         self.origin = origin
         self.worker_lost_timeout = worker_lost_timeout
         self.assignments: dict[str, _Assignment] = {}
+        # mirrors that must be deleted on a currently-unreachable worker:
+        # flushed when it reconnects (a lost delete would otherwise
+        # orphan worker quota forever)
+        self.pending_deletes: dict[str, set[str]] = {}
         # optional job-level dispatch (reference MultiKueueAdapter.SyncJob,
         # jobframework/interface.go:227): the manager's JobManager plus one
         # per worker cluster; jobs are mirrored instead of bare workloads
@@ -93,10 +130,23 @@ class MultiKueueController:
             priority=wl.priority, creation_time=wl.creation_time)
         return remote
 
+    def _worker_op(self, cluster: WorkerCluster, fn, *args, default=None):
+        """Run one transport operation; a connection failure marks the
+        cluster lost (multikueuecluster.go:134 watch loss)."""
+        from ..remote import ConnectionLost
+        try:
+            return fn(*args)
+        except ConnectionLost:
+            cluster.mark_lost(self.manager.clock())
+            return default
+
     def reconcile(self) -> None:
         now = self.manager.clock()
-        # connection health → eject assignments on lost workers
+        # connection health: retry lost workers with exponential backoff,
+        # eject assignments once a worker stays lost past the timeout
         for name, cluster in self.clusters.items():
+            if not cluster.active and cluster.try_reconnect(now):
+                self._flush_pending_deletes(name)
             if (not cluster.active and cluster.lost_since is not None
                     and now - cluster.lost_since > self.worker_lost_timeout):
                 self._eject_cluster(name)
@@ -151,8 +201,15 @@ class MultiKueueController:
                 continue
             if job is not None and cname in self.worker_jobs:
                 self._sync_job(cname, job)
-            elif wl.key not in cluster.driver.workloads:
-                cluster.driver.create_workload(self._mirror(wl))
+            else:
+                self._worker_op(cluster, cluster.client.create_workload,
+                                self._mirror(wl))
+                if not cluster.active:
+                    # the create may have landed before the connection
+                    # dropped: clean it up when the worker comes back
+                    self.pending_deletes.setdefault(cname, set()).add(
+                        wl.key)
+                    continue
             nominated.append(cname)
         if not nominated:
             return
@@ -167,7 +224,8 @@ class MultiKueueController:
                 cluster = self.clusters.get(cname)
                 if cluster is None or not cluster.active:
                     continue
-                remote = cluster.driver.workloads.get(key)
+                remote = self._worker_op(cluster,
+                                         cluster.client.get_workload, key)
                 if remote is not None and remote.has_quota_reservation:
                     asg.cluster = cname
                     break
@@ -185,7 +243,9 @@ class MultiKueueController:
         cluster = self.clusters.get(asg.cluster)
         if cluster is None or not cluster.active:
             return  # lost; ejection handled by the timeout scan
-        remote = cluster.driver.workloads.get(key)
+        remote = self._worker_op(cluster, cluster.client.get_workload, key)
+        if not cluster.active:
+            return  # connection dropped mid-sync
         if remote is None:
             # remote deleted under us → re-dispatch
             self._reset(key)
@@ -209,7 +269,11 @@ class MultiKueueController:
 
     def _delete_remote(self, cname: str, key: str) -> None:
         cluster = self.clusters.get(cname)
-        if cluster is None or not cluster.active:
+        if cluster is None:
+            return
+        if not cluster.active:
+            # unreachable: remember the delete for the reconnect flush
+            self.pending_deletes.setdefault(cname, set()).add(key)
             return
         worker_jm = self.worker_jobs.get(cname)
         if worker_jm is not None:
@@ -218,7 +282,30 @@ class MultiKueueController:
             for jkey, job in list(worker_jm.jobs.items()):
                 if worker_jm.reconciler.workload_key_for(job) == key:
                     worker_jm.delete(jkey)
-        cluster.driver.delete_workload(key)
+        self._worker_op(cluster, cluster.client.delete_workload, key)
+        if not cluster.active:
+            self.pending_deletes.setdefault(cname, set()).add(key)
+
+    def _flush_pending_deletes(self, cname: str) -> None:
+        """A reconnected worker may hold mirrors whose deletes were lost
+        while it was unreachable — its daemon could even have admitted
+        them; delete them before anything else dispatches."""
+        cluster = self.clusters.get(cname)
+        pending = self.pending_deletes.get(cname)
+        if cluster is None or not pending:
+            return
+        for key in list(pending):
+            # keep the mirror if it is (again) this worker's assignment
+            asg = self.assignments.get(key)
+            if asg is not None and asg.cluster == cname:
+                pending.discard(key)
+                continue
+            self._worker_op(cluster, cluster.client.delete_workload, key)
+            if not cluster.active:
+                return   # dropped again; retry on the next reconnect
+            pending.discard(key)
+        if not pending:
+            self.pending_deletes.pop(cname, None)
 
     def _cleanup(self, key: str) -> None:
         asg = self.assignments.pop(key, None)
@@ -246,12 +333,18 @@ class MultiKueueController:
 
     def run_gc(self) -> None:
         """Remote GC (multikueuecluster.go:255 runGC): delete worker
-        mirrors whose manager workload is gone."""
+        mirrors whose manager workload is gone.  One list round trip per
+        cluster ({key: finished}); stops on connection loss."""
         managed = set(self.manager.workloads)
         for cluster in self.clusters.values():
             if not cluster.active:
                 continue
-            for key in list(cluster.driver.workloads):
-                wl = cluster.driver.workloads[key]
-                if key not in managed and not wl.is_finished:
-                    cluster.driver.delete_workload(key)
+            listing = self._worker_op(cluster,
+                                      cluster.client.list_workloads,
+                                      default={})
+            for key, finished in listing.items():
+                if not cluster.active:
+                    break   # lost mid-GC: stop issuing doomed requests
+                if key not in managed and not finished:
+                    self._worker_op(cluster,
+                                    cluster.client.delete_workload, key)

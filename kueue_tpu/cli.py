@@ -419,6 +419,15 @@ def cmd_serve(store: Store, args) -> int:
     if profile_dir:
         from .profiling import start_trace
         start_trace(profile_dir)
+    worker_server = None
+    if getattr(args, "listen", None) is not None:
+        # MultiKueue worker mode: serve the remote-cluster API next to
+        # the admission daemon (kueue_tpu.remote.WorkerServer)
+        from .remote import WorkerServer
+        worker_server = WorkerServer(driver, port=args.listen)
+        worker_server.start()
+        print(f"worker API on http://127.0.0.1:{worker_server.port}",
+              flush=True)
     print(f"serving from {args.state_dir} (SIGUSR2 dumps state, "
           f"SIGTERM stops)", flush=True)
     try:
@@ -435,6 +444,8 @@ def cmd_serve(store: Store, args) -> int:
                 final.upsert(m.to_manifest(wl))
         final.save()
     finally:
+        if worker_server is not None:
+            worker_server.stop()
         lease.release()
     admitted = sorted(driver.admitted_keys())
     print(f"serve exiting: {len(admitted)} workloads holding quota")
@@ -539,6 +550,8 @@ def main(argv: list[str] | None = None) -> int:
                    help="exit once no workloads are pending (tests)")
     p.add_argument("--profile-dir", default=None,
                    help="write a jax.profiler trace here")
+    p.add_argument("--listen", type=int, default=None,
+                   help="serve the MultiKueue worker API on this port")
 
     p = sub.add_parser("import", help="bulk-import running pods")
     p.add_argument("-f", "--filename", required=True)

@@ -1,0 +1,235 @@
+"""Remote-cluster transport for MultiKueue (reference
+pkg/controller/admissionchecks/multikueue/multikueuecluster.go).
+
+The reference talks to worker clusters through kubeconfig REST clients
+with watch re-establishment and exponential retry.  The equivalent here
+is a small HTTP API served by each worker process next to its admission
+daemon (``cli serve --listen PORT``), and a manager-side client that
+marks the cluster lost on connection errors:
+
+    GET    /healthz
+    GET    /apis/workloads                       → {"keys": [...]}
+    GET    /apis/workloads/<ns>/<name>           → workload manifest
+    POST   /apis/workloads                       → create from manifest
+    DELETE /apis/workloads/<ns>/<name>
+    POST   /apis/workloads/<ns>/<name>/finish    → fake execution hook
+           (the perf-runner's condition flip; real jobs finish via the
+           worker's own jobframework)
+
+``LocalWorkerClient`` wraps an in-process Driver with the same surface
+(the multi-envtest-in-one-process pattern, SURVEY §4.3), so the
+MultiKueue controller is transport-agnostic.
+"""
+
+from __future__ import annotations
+
+import json
+import threading
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from typing import Optional
+
+from .api import manifests as m
+from .api.types import Workload
+
+
+class ConnectionLost(Exception):
+    """A transport failure: the cluster should be marked lost."""
+
+
+class LocalWorkerClient:
+    """In-process worker (a Driver in the same process).
+
+    ``ok`` is the fault-injection switch for tests: False makes health
+    probes fail so a mark_lost cluster stays lost (the multi-envtest
+    pattern's killed watch)."""
+
+    def __init__(self, driver):
+        self.driver = driver
+        self.ok = True
+
+    def healthy(self) -> bool:
+        return self.ok
+
+    def create_workload(self, wl: Workload) -> None:
+        if wl.key not in self.driver.workloads:
+            self.driver.create_workload(wl)
+
+    def get_workload(self, key: str) -> Optional[Workload]:
+        return self.driver.workloads.get(key)
+
+    def delete_workload(self, key: str) -> None:
+        self.driver.delete_workload(key)
+
+    def list_workload_keys(self) -> list[str]:
+        return list(self.driver.workloads)
+
+    def list_workloads(self) -> dict[str, bool]:
+        return {k: wl.is_finished
+                for k, wl in list(self.driver.workloads.items())}
+
+
+class HttpWorkerClient:
+    """Manager-side remote client (multikueuecluster.go remoteClient).
+
+    Any connection error raises ConnectionLost; the MultiKueue
+    controller marks the cluster inactive and retries with exponential
+    backoff (multikueuecluster.go:67 retryAfter)."""
+
+    def __init__(self, base_url: str, timeout: float = 5.0):
+        self.base_url = base_url.rstrip("/")
+        self.timeout = timeout
+
+    def _request(self, method: str, path: str, body: Optional[dict] = None):
+        import urllib.error
+        import urllib.request
+        data = None if body is None else json.dumps(body).encode()
+        req = urllib.request.Request(
+            self.base_url + path, data=data, method=method,
+            headers={"Content-Type": "application/json"})
+        try:
+            with urllib.request.urlopen(req, timeout=self.timeout) as resp:
+                payload = resp.read()
+                return json.loads(payload) if payload else None
+        except urllib.error.HTTPError as e:
+            if e.code < 500:
+                # application-level error (404 missing, 400 bad
+                # manifest): the cluster itself is healthy — don't flap
+                # it lost (multikueuecluster.go only reconnects on
+                # transport failures)
+                return None
+            raise ConnectionLost(f"{method} {path}: HTTP {e.code}") from e
+        except OSError as e:               # refused / reset / timeout
+            raise ConnectionLost(f"{method} {path}: {e}") from e
+
+    def healthy(self) -> bool:
+        try:
+            return self._request("GET", "/healthz") is not None
+        except ConnectionLost:
+            return False
+
+    def create_workload(self, wl: Workload) -> None:
+        self._request("POST", "/apis/workloads", m.to_manifest(wl))
+
+    def get_workload(self, key: str) -> Optional[Workload]:
+        ns, _, name = key.partition("/")
+        doc = self._request("GET", f"/apis/workloads/{ns}/{name}")
+        return m.from_manifest(doc) if doc else None
+
+    def delete_workload(self, key: str) -> None:
+        ns, _, name = key.partition("/")
+        self._request("DELETE", f"/apis/workloads/{ns}/{name}")
+
+    def list_workload_keys(self) -> list[str]:
+        out = self._request("GET", "/apis/workloads")
+        return list(out.get("keys", [])) if out else []
+
+    def list_workloads(self) -> dict[str, bool]:
+        """{key: is_finished} in ONE round trip (GC reads this)."""
+        out = self._request("GET", "/apis/workloads")
+        if not out:
+            return {}
+        if "finished" in out:
+            return {k: bool(v) for k, v in out["finished"].items()}
+        return {k: False for k in out.get("keys", [])}
+
+    def finish_workload(self, key: str, message: str = "finished") -> None:
+        """Test/executor hook: flip the remote workload finished."""
+        ns, _, name = key.partition("/")
+        self._request("POST", f"/apis/workloads/{ns}/{name}/finish",
+                      {"message": message})
+
+
+class _Handler(BaseHTTPRequestHandler):
+    driver = None  # bound by WorkerServer
+
+    def log_message(self, *args):  # quiet
+        pass
+
+    def _send(self, code: int, payload=None) -> None:
+        body = b"" if payload is None else json.dumps(payload).encode()
+        self.send_response(code)
+        self.send_header("Content-Type", "application/json")
+        self.send_header("Content-Length", str(len(body)))
+        self.end_headers()
+        if body:
+            self.wfile.write(body)
+
+    def _wl_key(self) -> Optional[str]:
+        parts = self.path.strip("/").split("/")
+        # apis/workloads/<ns>/<name>[/finish]
+        if len(parts) >= 4 and parts[0] == "apis" and parts[1] == "workloads":
+            return f"{parts[2]}/{parts[3]}"
+        return None
+
+    def do_GET(self):
+        if self.path == "/healthz":
+            self._send(200, {"ok": True})
+            return
+        if self.path.rstrip("/") == "/apis/workloads":
+            items = list(self.driver.workloads.items())
+            self._send(200, {"keys": [k for k, _ in items],
+                             "finished": {k: wl.is_finished
+                                          for k, wl in items}})
+            return
+        key = self._wl_key()
+        if key is not None:
+            wl = self.driver.workloads.get(key)
+            if wl is None:
+                self._send(404)
+            else:
+                self._send(200, m.to_manifest(wl))
+            return
+        self._send(404)
+
+    def do_POST(self):
+        length = int(self.headers.get("Content-Length") or 0)
+        body = json.loads(self.rfile.read(length)) if length else {}
+        if self.path.endswith("/finish"):
+            key = self._wl_key()
+            if key is None or key not in self.driver.workloads:
+                self._send(404)
+                return
+            self.driver.finish_workload(
+                key, body.get("message", "finished"))
+            self._send(200, {"ok": True})
+            return
+        if self.path.rstrip("/") == "/apis/workloads":
+            try:
+                wl = m.from_manifest(body)
+            except Exception:
+                self._send(400)
+                return
+            if wl.key not in self.driver.workloads:
+                self.driver.create_workload(wl)
+            self._send(201, {"ok": True})
+            return
+        self._send(404)
+
+    def do_DELETE(self):
+        key = self._wl_key()
+        if key is None:
+            self._send(404)
+            return
+        self.driver.delete_workload(key)
+        self._send(200, {"ok": True})
+
+
+class WorkerServer:
+    """The worker-side HTTP API, served next to the admission daemon."""
+
+    def __init__(self, driver, port: int = 0, host: str = "127.0.0.1"):
+        handler = type("BoundHandler", (_Handler,), {"driver": driver})
+        self.httpd = ThreadingHTTPServer((host, port), handler)
+        self.port = self.httpd.server_address[1]
+        self._thread: Optional[threading.Thread] = None
+
+    def start(self) -> None:
+        self._thread = threading.Thread(target=self.httpd.serve_forever,
+                                        daemon=True)
+        self._thread.start()
+
+    def stop(self) -> None:
+        self.httpd.shutdown()
+        self.httpd.server_close()
+        if self._thread is not None:
+            self._thread.join(timeout=5.0)

@@ -119,6 +119,7 @@ def test_multikueue_worker_loss_ejects_and_redispatches():
     holder = next(n for n, c in clusters.items()
                   if "default/job-c" in c.driver.workloads)
     other = next(n for n in clusters if n != holder)
+    clusters[holder].client.ok = False    # transport down: probes fail
     clusters[holder].mark_lost(clock())
     clock.tick(301.0)
     pump(manager, clusters, ctrl)
