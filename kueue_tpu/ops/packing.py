@@ -135,6 +135,17 @@ def _bucket(n: int, minimum: int = 8) -> int:
     return max(minimum, 1 << math.ceil(math.log2(max(1, n))))
 
 
+def coarse_bucket(n: int, ladder: tuple[int, ...]) -> int:
+    """Smallest ladder rung >= n (last rung if none).  Coarse ladders
+    keep the number of DISTINCT compiled shapes small — each new shape
+    is a full XLA compilation (~1s on this CPU) that would otherwise
+    land inside a scheduling cycle."""
+    for rung in ladder:
+        if n <= rung:
+            return rung
+    return ladder[-1]
+
+
 def _iter_nodes(snapshot: Snapshot):
     """CQs first, then cohorts (stable order)."""
     cq_names = sorted(snapshot.cluster_queues)

@@ -137,22 +137,28 @@ def minimal_preemptions_batch(usage0, subtree, guaranteed, borrow_cap,
                               cand_cq, cand_delta, cand_other_cq,
                               cand_above_threshold, allow_borrowing0,
                               threshold_enabled, *, depth: int):
-    """ALL of a cycle's preemption searches in ONE dispatch.
+    """ALL of a cycle's preemption searches in ONE dispatch, each over
+    its own FOREST-LOCAL node plane.
 
-    Every search runs against the same snapshot usage (the reference
-    computes each preempt head's targets independently at nominate),
-    so the searches vmap cleanly over a leading S axis: pre_cq [S],
-    wl_usage/frs_mask [S, F], cand_* [S, K], flags [S].  Returns
-    (fitted [S], target_mask [S, K]).  Padded rows (pre_cq = -1 or all
-    cand_cq = -1) come back unfitted."""
-    def one(pcq, wu, fm, cc, cd, co, ca, ab, te):
+    A search only ever touches its preemptor's cohort forest (candidates
+    are same-CQ or cohort CQs), so the host packs each search's quota
+    plane into compact [NL, F] slices (NL = forest-size bucket, ~8)
+    instead of the full [N, F] cluster — the scan carry per search drops
+    ~N/NL-fold.  All node-plane args carry a leading S axis:
+    usage0/subtree/guaranteed/borrow_cap [S, NL, F], has_blim [S, NL, F],
+    parent [S, NL]; per-search work: pre_cq [S] (local index),
+    wl_usage/frs_mask [S, F], cand_* [S, K] (local cq indices), flags
+    [S].  Returns (fitted [S], target_mask [S, K]); padded rows
+    (pre_cq = -1) come back unfitted."""
+    def one(u0, sub, gua, bc, hb, par, pcq, wu, fm, cc, cd, co, ca, ab, te):
         return _minimal_preemptions_core(
-            usage0, subtree, guaranteed, borrow_cap, has_blim, parent,
+            u0, sub, gua, bc, hb, par,
             jnp.maximum(pcq, 0), wu, fm, cc, cd, co, ca, ab, te, depth)
 
-    fitted, mask = jax.vmap(one)(pre_cq, wl_usage, frs_mask, cand_cq,
-                                 cand_delta, cand_other_cq,
-                                 cand_above_threshold, allow_borrowing0,
-                                 threshold_enabled)
+    fitted, mask = jax.vmap(one)(usage0, subtree, guaranteed, borrow_cap,
+                                 has_blim, parent, pre_cq, wl_usage,
+                                 frs_mask, cand_cq, cand_delta,
+                                 cand_other_cq, cand_above_threshold,
+                                 allow_borrowing0, threshold_enabled)
     valid = pre_cq >= 0
     return fitted & valid, mask & valid[:, None]

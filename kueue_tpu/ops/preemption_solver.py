@@ -23,6 +23,10 @@ from .preemption_kernel import minimal_preemptions
 
 _cpu_dev = None
 
+# shape ladders for the batched search (see coarse_bucket)
+S_LADDER = (32, 256, 1024, 4096)
+K_LADDER = (16, 128, 1024)
+
 
 def _cpu_device():
     """Candidate lists are small; a tunneled accelerator's ~100ms round
@@ -49,8 +53,66 @@ def _bucket(n: int, minimum: int = 8) -> int:
     return b
 
 
+class _ForestPlanes:
+    """Per-forest compact quota planes, cached per PackedStructure.
+
+    Each cohort forest's nodes are remapped to a dense local index space
+    (bucketed to NL) so a preemption search carries [NL, F] instead of
+    the whole [N, F] cluster."""
+
+    def __init__(self, st):
+        forest = np.asarray(st.forest_of_node)
+        N, F = st.subtree_quota.shape
+        per_forest: list[list[int]] = [[] for _ in range(st.n_forests)]
+        for ni in range(N):
+            per_forest[int(forest[ni])].append(ni)
+        self.NL = _bucket(max(1, max(len(v) for v in per_forest)),
+                          minimum=4)
+        G = st.n_forests
+        self.glob_idx = np.full((G, self.NL), -1, dtype=np.int32)
+        self.parent = np.full((G, self.NL), -1, dtype=np.int32)
+        self.subtree = np.zeros((G, self.NL, F), dtype=np.int32)
+        self.guaranteed = np.zeros((G, self.NL, F), dtype=np.int32)
+        self.borrow_cap = np.full((G, self.NL, F), 2**30, dtype=np.int32)
+        self.has_blim = np.zeros((G, self.NL, F), dtype=bool)
+        self.local: dict[int, tuple[int, int]] = {}   # global → (f, local)
+        for f, nodes in enumerate(per_forest):
+            if len(nodes) > self.NL:
+                raise ValueError("forest exceeds bucket")
+            loc = {g: i for i, g in enumerate(nodes)}
+            for i, g in enumerate(nodes):
+                self.glob_idx[f, i] = g
+                p = int(st.parent[g])
+                self.parent[f, i] = loc.get(p, -1) if p >= 0 else -1
+                self.subtree[f, i] = st.subtree_quota[g]
+                self.guaranteed[f, i] = st.guaranteed[g]
+                self.borrow_cap[f, i] = st.borrow_cap[g]
+                self.has_blim[f, i] = st.has_borrow_limit[g]
+                self.local[g] = (f, i)
+
+    def usage_planes(self, usage0: np.ndarray) -> np.ndarray:
+        """[G, NL, F] usage slices from the cycle's [N, F] usage."""
+        safe = np.maximum(self.glob_idx, 0)
+        return usage0[safe] * (self.glob_idx >= 0)[:, :, None]
+
+
+def _planes_for(packed) -> Optional[_ForestPlanes]:
+    st = getattr(packed, "structure", None)
+    if st is None:
+        return None
+    planes = getattr(st, "_preempt_planes", None)
+    if planes is None:
+        try:
+            planes = _ForestPlanes(st)
+        except ValueError:
+            return None
+        st._preempt_planes = planes
+    return planes
+
+
 def device_minimal_preemptions_batch(specs, packed):
-    """ALL of a cycle's preemption searches in one vmapped dispatch.
+    """ALL of a cycle's preemption searches in one vmapped dispatch,
+    each over its preemptor's forest-local quota plane.
 
     ``specs``: [(ctx, candidates, allow_borrowing, threshold)] — the
     per-head search requests the preemptor planned (every search is
@@ -60,6 +122,9 @@ def device_minimal_preemptions_batch(specs, packed):
     from ..scheduler.preemption import Target  # circular-safe import
 
     if packed is None or not packed.exact or not specs:
+        return None
+    planes = _planes_for(packed)
+    if planes is None:
         return None
     cq_idx = {n: i for i, n in enumerate(packed.cq_names)}
     F = packed.usage0.shape[1]
@@ -80,10 +145,19 @@ def device_minimal_preemptions_batch(specs, packed):
             return None
         return vec.astype(np.int32)
 
-    # generous bucket floors: each distinct (S, K) combination is one
-    # XLA compilation — keep the variety low across a run's cycles
-    S = _bucket(len(specs), minimum=32)
-    K = _bucket(max(1, max(len(c) for _, c, _, _ in specs)), minimum=16)
+    # coarse shape ladders: each distinct (S, K) combination is one XLA
+    # compilation — a handful of rungs covers every cycle, and warmup
+    # pre-compiles them (CycleSolver.warmup).  Beyond the top rung the
+    # host path runs (None), never an array overflow.
+    from .packing import coarse_bucket
+    max_cands = max(1, max(len(c) for _, c, _, _ in specs))
+    if len(specs) > S_LADDER[-1] or max_cands > K_LADDER[-1]:
+        return None
+    S = coarse_bucket(len(specs), S_LADDER)
+    K = coarse_bucket(max_cands, K_LADDER)
+    NL = planes.NL
+    usage_planes = planes.usage_planes(packed.usage0)     # [G, NL, F]
+    forest_of = np.zeros(S, dtype=np.int32)
     pre_cq = np.full(S, -1, dtype=np.int32)
     wl_usage = np.zeros((S, F), dtype=np.int32)
     frs_mask = np.zeros((S, F), dtype=bool)
@@ -99,12 +173,14 @@ def device_minimal_preemptions_batch(specs, packed):
 
     for si, (ctx, candidates, allow_borrowing, threshold) in enumerate(specs):
         ci = cq_idx.get(ctx.preemptor_cq.name)
-        if ci is None:
+        if ci is None or ci not in planes.local:
             return None
+        f, ci_local = planes.local[ci]
         wu = to_f_vec(ctx.workload_usage)
         if wu is None:
             return None
-        pre_cq[si] = ci
+        forest_of[si] = f
+        pre_cq[si] = ci_local
         wl_usage[si] = wu
         for fr in ctx.frs_need_preemption:
             fi = packed.fr_index.get(fr)
@@ -117,13 +193,16 @@ def device_minimal_preemptions_batch(specs, packed):
             cci = cq_idx.get(cand.cluster_queue)
             if cci is None:
                 return None
+            cf_local = planes.local.get(cci)
+            if cf_local is None or cf_local[0] != f:
+                return None   # candidate outside the preemptor's forest
             delta = vec_cache.get(cand.key)
             if delta is None and cand.key not in vec_cache:
                 delta = to_f_vec(cand.usage())
                 vec_cache[cand.key] = delta
             if delta is None:
                 return None
-            cand_cq[si, k] = cci
+            cand_cq[si, k] = cf_local[1]
             cand_delta[si, k] = delta
             cand_other[si, k] = cand.cluster_queue != ctx.preemptor_cq.name
             cand_above[si, k] = (threshold is not None
@@ -133,8 +212,9 @@ def device_minimal_preemptions_batch(specs, packed):
     from .preemption_kernel import minimal_preemptions_batch
     with jax.default_device(_cpu_device()):
         fitted, mask = minimal_preemptions_batch(
-            packed.usage0, packed.subtree_quota, packed.guaranteed,
-            packed.borrow_cap, packed.has_borrow_limit, packed.parent,
+            usage_planes[forest_of], planes.subtree[forest_of],
+            planes.guaranteed[forest_of], planes.borrow_cap[forest_of],
+            planes.has_blim[forest_of], planes.parent[forest_of],
             pre_cq, wl_usage, frs_mask, cand_cq, cand_delta, cand_other,
             cand_above, allow_b0, thr_en, depth=packed.depth)
     fitted = np.asarray(fitted)

@@ -50,8 +50,8 @@ from ..scheduler.flavorassigner import (
     PodSetAssignmentResult,
 )
 from ..resources import FlavorResource, Requests
-from .packing import (PackedCycle, PackedStructure, _bucket, pack_cycle,
-                      pack_structure)
+from .packing import (PackedCycle, PackedStructure, _bucket, coarse_bucket,
+                      pack_cycle, pack_structure)
 from .cycle import (admit_scan, admit_scan_forests, admit_scan_preempt,
                     classify_np, cycle_order_np, decision_pairs_from_slots)
 
@@ -61,6 +61,11 @@ from .cycle import (admit_scan, admit_scan_forests, admit_scan_preempt,
 _FOREST_MIN_HEADS = 64
 
 _DEFAULT_FF = FlavorFungibility()
+
+# coarse shape ladders for the preempt scan's target tensors: each
+# distinct (T, MT) is one XLA compilation (see packing.coarse_bucket)
+T_LADDER = (64, 512, 4096)
+MT_LADDER = (4, 16)
 
 
 @dataclass
@@ -301,9 +306,6 @@ class CycleSolver:
                         if mfw >= top:
                             break
                         mfw *= 2
-            # preemption-aware scan: warm + calibrate the common
-            # small-target-universe buckets (T=8, MT=2); bigger universes
-            # still compile on first use
             # first padded-K bucket (scalar heads with more decision
             # pairs than R, _build_pair_tensors): compile so a
             # multi-PodSet head can't stall a cycle on compilation
@@ -318,23 +320,65 @@ class CycleSolver:
                 with jax.default_device(dev):
                     jax.device_get(admit_scan(*kargs, depth=st.depth))
 
-            T, MT = 8, 2
+            # warm every (T, MT) rung that can appear at this head count
+            # (an in-scan preemption universe is at most a few targets
+            # per head x heads); only the SMALLEST T's timing feeds the
+            # router calibration — it is the common case, and routing
+            # tiny scans by large-T timings would favor the tunnel
+            t_top = coarse_bucket(4 * W, T_LADDER)
+            for T in [t for t in T_LADDER if t <= t_top]:
+                mts = MT_LADDER if T == T_LADDER[0] else MT_LADDER[:1]
+                for MT in mts:
+                    pargs = args[:-1] + (
+                        np.zeros(W, bool),
+                        np.full((W, R), -1, np.int32),
+                        np.zeros((W, R), np.int32),
+                        np.full((W, MT), -1, np.int32),
+                        np.zeros(T, np.int32),
+                        np.zeros((T, F), np.int32), args[-1])
+                    for dev in devs:
+                        name = "accel" if dev is self._accel_dev else "cpu"
+                        reps = 3 if dev is self._accel_dev else 2
+                        with jax.default_device(dev):
+                            for _ in range(reps):
+                                t0 = _time.perf_counter()
+                                jax.device_get(admit_scan_preempt(
+                                    *pargs, depth=st.depth))
+                                dt = _time.perf_counter() - t0
+                        if T == T_LADDER[0] and MT == MT_LADDER[0]:
+                            self.calibration[(name, "preempt", W, W)] = dt
 
-            pargs = args[:-1] + (
-                np.zeros(W, bool),
-                np.full((W, R), -1, np.int32), np.zeros((W, R), np.int32),
-                np.full((W, MT), -1, np.int32), np.zeros(T, np.int32),
-                np.zeros((T, F), np.int32), args[-1])
-            for dev in devs:
-                name = "accel" if dev is self._accel_dev else "cpu"
-                reps = 3 if dev is self._accel_dev else 2
-                with jax.default_device(dev):
-                    for _ in range(reps):
-                        t0 = _time.perf_counter()
-                        jax.device_get(admit_scan_preempt(
-                            *pargs, depth=st.depth))
-                        dt = _time.perf_counter() - t0
-                self.calibration[(name, "preempt", W, W)] = dt
+        # batched preemption search: compile the (S, K) rungs a run of
+        # this size can hit (S <= 2 specs per head; K rungs beyond 128
+        # are rare enough to compile on first use)
+        from .preemption_kernel import minimal_preemptions_batch
+        from .preemption_solver import _ForestPlanes, K_LADDER, S_LADDER
+        try:
+            planes = _ForestPlanes(st)
+        except ValueError:
+            planes = None
+        if planes is not None:
+            st._preempt_planes = planes
+            NL = planes.NL
+            s_top = coarse_bucket(2 * max_heads, S_LADDER)
+            with jax.default_device(self._cpu_dev):
+                for S in [s for s in S_LADDER if s <= s_top]:
+                    for K in K_LADDER[:2]:
+                        jax.device_get(minimal_preemptions_batch(
+                            np.zeros((S, NL, F), np.int32),
+                            np.zeros((S, NL, F), np.int32),
+                            np.zeros((S, NL, F), np.int32),
+                            np.full((S, NL, F), 2**30, np.int32),
+                            np.zeros((S, NL, F), bool),
+                            np.full((S, NL), -1, np.int32),
+                            np.full(S, -1, np.int32),
+                            np.zeros((S, F), np.int32),
+                            np.zeros((S, F), bool),
+                            np.full((S, K), -1, np.int32),
+                            np.zeros((S, K, F), np.int32),
+                            np.zeros((S, K), bool), np.zeros((S, K), bool),
+                            np.zeros(S, bool), np.zeros(S, bool),
+                            depth=st.depth))
 
     # -- structure cache -----------------------------------------------
 
@@ -653,8 +697,12 @@ class CycleSolver:
                 idxs.append(ti)
             per_wi[wi] = idxs
 
-        T = _bucket(max(1, len(universe)), minimum=8)
-        MT = _bucket(max(1, max(len(v) for v in per_wi.values())), minimum=2)
+        n_universe = max(1, len(universe))
+        n_per_head = max(1, max(len(v) for v in per_wi.values()))
+        if n_universe > T_LADDER[-1] or n_per_head > MT_LADDER[-1]:
+            return None   # beyond the shape ladders: host path
+        T = coarse_bucket(n_universe, T_LADDER)
+        MT = coarse_bucket(n_per_head, MT_LADDER)
         tu_cq = np.zeros(T, dtype=np.int32)
         tu_delta = np.zeros((T, F), dtype=np.int32)
         tu_cq[:len(cqs)] = cqs
