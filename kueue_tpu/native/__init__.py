@@ -28,6 +28,14 @@ class NativeBuildError(RuntimeError):
     pass
 
 
+def _i32(a):
+    return np.ascontiguousarray(a, dtype=np.int32)
+
+
+def _u8(a):
+    return np.ascontiguousarray(a, dtype=np.uint8)
+
+
 def _build() -> None:
     cmd = ["g++", "-O2", "-shared", "-fPIC", "-o", _LIB, _SRC]
     proc = subprocess.run(cmd, capture_output=True, text=True)
@@ -53,6 +61,12 @@ def _load():
             + [i32p, i32p, i32p, i32p, u8p, i32p, i32p, i32p, u8p, u8p,
                i32p, i32p]
             + [i32p, u8p, u8p])
+        lib.admit_scan.restype = None
+        lib.admit_scan.argtypes = (
+            [ctypes.c_int32] * 5
+            + [i32p, i32p, i32p, i32p, u8p, i32p, i32p, i32p,
+               i32p, i32p, i32p, u8p, i32p, i32p, u8p, u8p, i32p]
+            + [u8p])
         _lib = lib
         return lib
 
@@ -77,22 +91,44 @@ def classify_cycle(packed):
     F = packed.usage0.shape[1]
     W = packed.wl_cq.shape[0]
 
-    def i32(a):
-        return np.ascontiguousarray(a, dtype=np.int32)
-
-    def u8(a):
-        return np.ascontiguousarray(a, dtype=np.uint8)
-
     fit_slot = np.empty(W, dtype=np.int32)
     borrows = np.empty(W, dtype=np.uint8)
     preempt = np.empty(W, dtype=np.uint8)
     lib.classify_cycle(
         N, F, C, S, R, W,
-        i32(packed.usage0), i32(packed.subtree_quota),
-        i32(packed.guaranteed), i32(packed.borrow_cap),
-        u8(packed.has_borrow_limit), i32(packed.parent),
-        i32(packed.nominal_cq), i32(packed.slot_fr),
-        u8(packed.slot_valid), u8(packed.cq_can_preempt_borrow),
-        i32(packed.wl_cq), i32(packed.wl_requests),
+        _i32(packed.usage0), _i32(packed.subtree_quota),
+        _i32(packed.guaranteed), _i32(packed.borrow_cap),
+        _u8(packed.has_borrow_limit), _i32(packed.parent),
+        _i32(packed.nominal_cq), _i32(packed.slot_fr),
+        _u8(packed.slot_valid), _u8(packed.cq_can_preempt_borrow),
+        _i32(packed.wl_cq), _i32(packed.wl_requests),
         fit_slot, borrows, preempt)
     return fit_slot, borrows.astype(bool), preempt.astype(bool)
+
+
+def admit_scan(packed, dec_fr, dec_amt, fit_mask, res_fr, res_amt,
+               res_mask, res_borrows, order):
+    """The sequential admit loop in the compiled core — identical
+    decisions to ops/cycle.admit_scan (tests/test_native_core.py).
+
+    Decision inputs are the (flavor-resource, amount) pair tensors the
+    solver builds (CycleSolver._build_pair_tensors).  Returns
+    admitted [W] bool in head order."""
+    lib = _load()
+    st = packed.structure
+    N = packed.node_count
+    F = packed.usage0.shape[1]
+    C = len(packed.cq_names)
+    W, K = np.asarray(dec_fr).shape
+
+    admitted = np.empty(W, dtype=np.uint8)
+    lib.admit_scan(
+        N, F, C, K, W,
+        _i32(packed.usage0), _i32(packed.subtree_quota),
+        _i32(packed.guaranteed), _i32(packed.borrow_cap),
+        _u8(packed.has_borrow_limit), _i32(packed.parent),
+        _i32(packed.nominal_cq), _i32(st.nominal_plus_blimit_cq),
+        _i32(packed.wl_cq), _i32(dec_fr), _i32(dec_amt), _u8(fit_mask),
+        _i32(res_fr), _i32(res_amt), _u8(res_mask), _u8(res_borrows),
+        _i32(order), admitted)
+    return admitted.astype(bool)

@@ -1,12 +1,13 @@
-// Native cycle core: the batched nominate/classify pass of the admission
-// cycle (the same semantics as kueue_tpu/ops/cycle.py solve_cycle with
-// run_scan=False, which itself mirrors reference
-// flavorassigner.go:499/:692) as a C library.
+// Native cycle core: the batched nominate/classify pass AND the
+// sequential admit scan of the admission cycle (the same semantics as
+// kueue_tpu/ops/cycle.py solve_cycle / admit_scan, which themselves
+// mirror reference flavorassigner.go:499/:692 and scheduler.go:176-284)
+// as a C library.
 //
 // This is the CPU-native backend of the solver plane: deployments without
 // an accelerator (or cycles too small to amortize a device dispatch) run
-// the identical classification here; decision parity with both the JAX
-// kernel and the scalar host oracle is enforced by
+// the identical classification + admit loop here; decision parity with
+// both the JAX kernels and the scalar host oracle is enforced by
 // tests/test_native_core.py.
 //
 // Build: g++ -O2 -shared -fPIC -o libcyclecore.so cycle_core.cpp
@@ -126,6 +127,119 @@ void classify_cycle(
             }
         }
         if (fit_slot_out[w] < 0 && any_preempt) preempt_out[w] = 1;
+    }
+}
+
+// The sequential admit loop over `order` (ops/cycle.py admit_scan; the
+// reference admit loop's fixed-assignment fits re-check + capacity
+// reserves, scheduler.go:245,383-408).  Decisions are per-head
+// (flavor-resource, amount) pairs — assignment.Usage, exactly what the
+// reference re-checks.  Mutates a private copy of usage.
+void admit_scan(
+    int32_t N, int32_t F, int32_t C, int32_t K, int32_t W,
+    const int32_t* usage0,        // [N,F]
+    const int32_t* subtree,       // [N,F]
+    const int32_t* guaranteed,    // [N,F]
+    const int32_t* borrow_cap,    // [N,F]
+    const uint8_t* has_blim,      // [N,F]
+    const int32_t* parent,        // [N]
+    const int32_t* nominal_cq,    // [C,F]
+    const int32_t* npb_cq,        // [C,F] nominal+borrowingLimit
+    const int32_t* wl_cq,         // [W]
+    const int32_t* dec_fr,        // [W,K] F-index or -1
+    const int32_t* dec_amt,       // [W,K]
+    const uint8_t* fit_mask,      // [W]
+    const int32_t* res_fr,        // [W,K]
+    const int32_t* res_amt,       // [W,K]
+    const uint8_t* res_mask,      // [W]
+    const uint8_t* res_borrows,   // [W]
+    const int32_t* order,         // [W] cycle order
+    uint8_t* admitted_out) {      // [W]
+
+    std::vector<int32_t> usage(usage0, usage0 + (size_t)N * F);
+    std::vector<int> chain;
+
+    auto add_chain = [&](int node, int f, int64_t val) {
+        // addUsage bubbling (resource_node.go:123)
+        int64_t carry = val;
+        for (int cur = node; cur >= 0 && carry != 0; cur = parent[cur]) {
+            int64_t u = usage[(size_t)cur * F + f];
+            int64_t g = guaranteed[(size_t)cur * F + f];
+            int64_t local_avail = std::max<int64_t>(0, g - u);
+            usage[(size_t)cur * F + f] = (int32_t)(u + carry);
+            carry = std::max<int64_t>(0, carry - local_avail);
+        }
+    };
+
+    for (int w = 0; w < W; ++w) admitted_out[w] = 0;
+    for (int oi = 0; oi < W; ++oi) {
+        int wi = order[oi];
+        if (wi < 0 || wi >= W) continue;
+        int cq = wl_cq[wi];
+        if (cq < 0) continue;
+
+        // the entry's root→cq chain depends only on cq: collect once,
+        // reuse across the K pairs (no per-pair allocation)
+        chain.clear();
+        for (int cur = cq; cur >= 0; cur = parent[cur]) chain.push_back(cur);
+
+        auto avail_at = [&](int f) -> int64_t {
+            int root = chain.back();
+            int64_t a = (int64_t)subtree[(size_t)root * F + f]
+                        - usage[(size_t)root * F + f];
+            for (int i = (int)chain.size() - 2; i >= 0; --i) {
+                int cur = chain[i];
+                int64_t u = usage[(size_t)cur * F + f];
+                int64_t g = guaranteed[(size_t)cur * F + f];
+                int64_t parent_avail = a;
+                if (has_blim[(size_t)cur * F + f]) {
+                    int64_t used_in_parent = std::max<int64_t>(0, u - g);
+                    int64_t blim_cap =
+                        (int64_t)borrow_cap[(size_t)cur * F + f]
+                        - used_in_parent;
+                    parent_avail = std::min(blim_cap, parent_avail);
+                }
+                a = std::max<int64_t>(0, g - u) + parent_avail;
+            }
+            return a;
+        };
+
+        if (fit_mask[wi]) {
+            bool ok = true;
+            for (int k = 0; k < K && ok; ++k) {
+                int f = dec_fr[(size_t)wi * K + k];
+                if (f < 0) continue;
+                if (dec_amt[(size_t)wi * K + k] > avail_at(f)) ok = false;
+            }
+            if (ok) {
+                admitted_out[wi] = 1;
+                for (int k = 0; k < K; ++k) {
+                    int f = dec_fr[(size_t)wi * K + k];
+                    if (f >= 0)
+                        add_chain(cq, f, dec_amt[(size_t)wi * K + k]);
+                }
+            }
+        }
+        if (res_mask[wi]) {
+            // resourcesToReserve (scheduler.go:383-408)
+            for (int k = 0; k < K; ++k) {
+                int f = res_fr[(size_t)wi * K + k];
+                if (f < 0) continue;
+                int64_t amt = res_amt[(size_t)wi * K + k];
+                int64_t cur = usage[(size_t)cq * F + f];
+                int64_t rdelta;
+                if (res_borrows[wi]) {
+                    rdelta = std::min<int64_t>(
+                        amt, (int64_t)npb_cq[(size_t)cq * F + f] - cur);
+                } else {
+                    rdelta = std::max<int64_t>(
+                        0, std::min<int64_t>(
+                            amt,
+                            (int64_t)nominal_cq[(size_t)cq * F + f] - cur));
+                }
+                add_chain(cq, f, rdelta);
+            }
+        }
     }
 }
 

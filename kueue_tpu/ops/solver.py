@@ -132,9 +132,10 @@ class CycleSolver:
 
     backend="auto" routes the admit scan to the accelerator when the
     cycle is big enough to amortize the dispatch round-trip, else to the
-    XLA CPU backend; "cpu"/"accel" force a backend; "native" runs the
-    C++ phase-1 core (kueue_tpu/native) with the scan on CPU.  Identical
-    decisions on every backend."""
+    XLA CPU backend; "cpu"/"accel" force a backend; "native" runs both
+    the classify AND the admit loop in the C++ core (kueue_tpu/native;
+    preempt-target cycles keep the jitted scan).  Identical decisions on
+    every backend."""
 
     def __init__(self, ordering: Ordering | None = None,
                  backend: str = "auto",
@@ -157,6 +158,7 @@ class CycleSolver:
             # dispatch routing within full cycles (also disjoint):
             "accel_dispatches": 0,    # admit scan ran on the accelerator
             "cpu_dispatches": 0,      # admit scan ran on the XLA CPU backend
+            "native_dispatches": 0,   # admit loop ran in the C++ core
             "skipped_dispatches": 0,  # no fit head -> scan provably no-op
             "singleton_dispatches": 0,  # <=1 entry/forest -> no contention
             "structure_rebuilds": 0,
@@ -774,6 +776,18 @@ class CycleSolver:
                     return handle
 
         has_preempt = bool(pmask.any())
+        if self.backend == "native" and not has_preempt:
+            # the C++ core runs the admit loop synchronously (preempt
+            # cycles keep the jitted scan — no native twin yet)
+            from .. import native
+            handle.admitted = native.admit_scan(
+                packed, dec_fr, dec_amt, fit_mask, res_fr, res_amt,
+                rmask, res_borrows, order)
+            handle.preempting = zeros
+            handle.overlap_skip = zeros
+            handle.route = "native"
+            self.stats["native_dispatches"] += 1
+            return handle
         mfw = self._forest_bucket(packed) if not has_preempt else None
         kernel = ("preempt" if has_preempt
                   else "flat" if mfw is None else "forest")

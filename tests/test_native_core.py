@@ -54,3 +54,55 @@ def test_native_end_to_end_parity_vs_host(seed):
     (host, _), (nat, d_nat) = results
     assert host == nat
     assert (d_nat.scheduler.solver.stats["full_cycles"] + d_nat.scheduler.solver.stats["classify_cycles"]) >= 1
+
+
+def test_native_admit_scan_matches_jitted():
+    """The C++ admit loop must equal ops/cycle.admit_scan decision-for-
+    decision on contended cycles (pairs, borrowing, in-scan skips)."""
+    import jax
+    from kueue_tpu.ops.cycle import (admit_scan, cycle_order_np,
+                                     decision_pairs_from_slots)
+
+    packed = _packed(n_cohorts=4, cqs_per_cohort=4, n_workloads=64,
+                     contended=True)
+    st = packed.structure
+    from kueue_tpu.ops.cycle import classify_np
+    out = classify_np(packed)
+    dec_fr, dec_amt, fit_mask = decision_pairs_from_slots(
+        st.slot_fr, packed.wl_cq, packed.wl_requests, out["fit_slot0"])
+    W = packed.wl_cq.shape[0]
+    res_fr = np.full_like(dec_fr, -1)
+    res_amt = np.zeros_like(dec_amt)
+    no_res = np.zeros(W, dtype=bool)
+    order = cycle_order_np(out["borrows0"], packed.wl_priority,
+                           packed.wl_timestamp)
+    jitted = np.asarray(jax.device_get(admit_scan(
+        packed.usage0, st.subtree_quota, st.guaranteed, st.borrow_cap,
+        st.has_borrow_limit, st.parent, st.nominal_cq,
+        st.nominal_plus_blimit_cq, packed.wl_cq, dec_fr, dec_amt,
+        fit_mask, res_fr, res_amt, no_res, no_res, order,
+        depth=st.depth)))
+    nat = native.admit_scan(packed, dec_fr, dec_amt, fit_mask, res_fr,
+                            res_amt, no_res, no_res, order)
+    np.testing.assert_array_equal(nat, jitted)
+    n = packed.wl_count
+    assert jitted[:n].any() and not jitted[:n].all(), \
+        "scenario must have both admits and in-scan losers"
+
+
+@pytest.mark.parametrize("seed", [31, 32])
+def test_native_backend_full_cycle_parity(seed):
+    """Driver with solver_backend='native': the C++ classify AND the C++
+    admit loop decide cycles, matching the host decision-for-decision."""
+    from tests.test_device_cycle import build_driver, drive_cycles
+    host, hclock, hwl = build_driver(seed, use_device=False,
+                                     preemption=False)
+    nat, nclock, nwl = build_driver(seed, use_device=True,
+                                    preemption=False)
+    nat.scheduler.solver.backend = "native"
+    hlog = drive_cycles(host, hclock, hwl)
+    nlog = drive_cycles(nat, nclock, nwl)
+    for cyc, (h, nv) in enumerate(zip(hlog, nlog)):
+        assert h == nv, f"seed {seed} cycle {cyc}:\nhost={h}\nnative={nv}"
+    stats = nat.scheduler.solver.stats
+    assert stats["host_cycles"] == 0, stats
