@@ -183,10 +183,13 @@ void admit_scan(
         chain.clear();
         for (int cur = cq; cur >= 0; cur = parent[cur]) chain.push_back(cur);
 
-        auto avail_at = [&](int f) -> int64_t {
+        // per-step int32 truncation bit-matches the jitted kernel's
+        // int32 arithmetic (the packer's x64 headroom keeps real values
+        // in range; parity, not extra range, is the contract here)
+        auto avail_at = [&](int f) -> int32_t {
             int root = chain.back();
-            int64_t a = (int64_t)subtree[(size_t)root * F + f]
-                        - usage[(size_t)root * F + f];
+            int32_t a = (int32_t)((int64_t)subtree[(size_t)root * F + f]
+                                  - usage[(size_t)root * F + f]);
             for (int i = (int)chain.size() - 2; i >= 0; --i) {
                 int cur = chain[i];
                 int64_t u = usage[(size_t)cur * F + f];
@@ -199,7 +202,7 @@ void admit_scan(
                         - used_in_parent;
                     parent_avail = std::min(blim_cap, parent_avail);
                 }
-                a = std::max<int64_t>(0, g - u) + parent_avail;
+                a = (int32_t)(std::max<int64_t>(0, g - u) + parent_avail);
             }
             return a;
         };

@@ -124,7 +124,7 @@ class DispatchHandle:
     preempting: Optional[np.ndarray] = None
     overlap_skip: Optional[np.ndarray] = None
     fit_mask: Optional[np.ndarray] = None  # [W] bool: vector + scalar fits
-    route: str = ""              # "accel" | "cpu" | "no_fit" | "singleton"
+    route: str = ""   # "accel" | "cpu" | "native" | "no_fit" | "singleton"
 
 
 class CycleSolver:

@@ -15,6 +15,7 @@ import pytest
 from kueue_tpu.api.types import (
     Admission,
     ClusterQueue,
+    Cohort,
     FlavorQuotas,
     LocalQueue,
     PodSet,
@@ -48,12 +49,15 @@ NAMESPACES = {
 }
 
 
-def fixture_driver(use_device, extra_cqs=(), extra_lqs=()):
+def fixture_driver(use_device, extra_cqs=(), extra_lqs=(), extra_cohorts=(),
+                   fair_sharing=False):
     """The TestSchedule shared fixture (scheduler_test.go:78-180)."""
     clock = FakeClock()
     d = Driver(clock=clock, namespaces=NAMESPACES,
-               use_device_solver=use_device,
+               use_device_solver=use_device, fair_sharing=fair_sharing,
                solver_backend="cpu" if use_device else "auto")
+    for cohort in extra_cohorts:
+        d.apply_cohort(cohort)
     for f in ("default", "on-demand", "spot", "model-a"):
         d.apply_resource_flavor(ResourceFlavor(name=f))
     # the reference gives sales borrowingLimit "0" — with no cohort that
@@ -471,3 +475,140 @@ def test_only_one_borrower_when_cohort_cannot_fit(use_device):
     assert "sales/wl2" in set(stats.skipped)
     heap, parked = queue_state(d, "cq2")
     assert "sales/wl2" in heap | parked
+
+
+# --- :1487 "with fair sharing: schedule workload with lowest share first"
+
+def test_fs_lowest_share_first(use_device):
+    extra_cqs = [ClusterQueue(
+        name="eng-shared", cohort="eng",
+        resource_groups=[ResourceGroup(covered_resources=["cpu"], flavors=[
+            FlavorQuotas(name="on-demand", resources={
+                "cpu": ResourceQuota(nominal=10_000,
+                                     borrowing_limit=0)})])])]
+    d, clock = fixture_driver(use_device, extra_cqs, fair_sharing=True)
+    admitted(d, "all_nominal", "eng-alpha", "eng-alpha",
+             [("one", 50, {"cpu": 50_000}, {"cpu": "on-demand"})])
+    admitted(d, "borrowing", "eng-beta", "eng-beta",
+             [("one", 55, {"cpu": 55_000}, {"cpu": "on-demand"})])
+    pending(d, "older-new", "eng-beta", "main", [("one", 1, {"cpu": 1000})],
+            created=1.0)
+    pending(d, "new", "eng-alpha", "main", [("one", 5, {"cpu": 1000})],
+            created=2.0)
+    stats = run_case(d, clock)
+    # eng-beta borrows (share > 0), eng-alpha is all-nominal: alpha wins
+    # the tournament despite the later timestamp
+    assert set(stats.admitted) == {"eng-alpha/new"}
+    heap, parked = queue_state(d, "eng-beta")
+    assert "eng-beta/older-new" in heap | parked
+
+
+# --- :1569 "hierarchical fair sharing ... wins tournament" ---------------
+
+def _hier_fs_driver(use_device):
+    cohorts = [
+        Cohort(name="coh-a", resource_groups=[ResourceGroup(
+            covered_resources=["cpu"], flavors=[FlavorQuotas(
+                name="on-demand", resources={
+                    "cpu": ResourceQuota(nominal=200_000)})])]),
+        Cohort(name="coh-b", parent_name="coh-a"),
+        Cohort(name="coh-c", parent_name="coh-a"),
+    ]
+    extra_cqs = [ClusterQueue(
+        name=n, cohort=c,
+        resource_groups=[ResourceGroup(covered_resources=["cpu"], flavors=[
+            FlavorQuotas(name="on-demand", resources={
+                "cpu": ResourceQuota(nominal=0)})])])
+        for n, c in (("d", "coh-b"), ("e", "coh-b"), ("f", "coh-c"), ("g", "coh-c"))]
+    extra_lqs = tuple(("eng-alpha", f"lq-{n}", n) for n in "defg")
+    return fixture_driver(use_device, extra_cqs, extra_lqs,
+                          extra_cohorts=cohorts, fair_sharing=True)
+
+
+def test_fs_hierarchical_tournament(use_device):
+    """d1 wins: B's post-admission share (100) is below C's (101), and d
+    beat e at the lower tournament level (scheduler_test.go:1539-1568)."""
+    d, clock = _hier_fs_driver(use_device)
+    admitted(d, "d0", "eng-alpha", "d",
+             [("one", 1, {"cpu": 10_000}, {"cpu": "on-demand"})])
+    admitted(d, "e0", "eng-alpha", "e",
+             [("one", 1, {"cpu": 20_000}, {"cpu": "on-demand"})])
+    admitted(d, "g0", "eng-alpha", "g",
+             [("one", 1, {"cpu": 100_000}, {"cpu": "on-demand"})])
+    pending(d, "d1", "eng-alpha", "lq-d", [("one", 1, {"cpu": 70_000})])
+    pending(d, "e1", "eng-alpha", "lq-e", [("one", 1, {"cpu": 61_000})])
+    pending(d, "f1", "eng-alpha", "lq-f", [("one", 1, {"cpu": 1000})])
+    pending(d, "g1", "eng-alpha", "lq-g", [("one", 1, {"cpu": 1000})])
+    stats = run_case(d, clock)
+    assert set(stats.admitted) == {"eng-alpha/d1"}
+    for cq, key in (("e", "eng-alpha/e1"), ("f", "eng-alpha/f1"),
+                    ("g", "eng-alpha/g1")):
+        heap, parked = queue_state(d, cq)
+        assert key in heap | parked, (cq, key)
+
+
+# --- :1681 "lowest drf after admission" ----------------------------------
+
+def test_fs_lowest_drf_after_admission(use_device):
+    cohorts = [Cohort(name="coh-a", resource_groups=[ResourceGroup(
+        covered_resources=["cpu"], flavors=[FlavorQuotas(
+            name="on-demand", resources={
+                "cpu": ResourceQuota(nominal=100_000)})])])]
+    extra_cqs = [ClusterQueue(
+        name=n, cohort="coh-a",
+        resource_groups=[ResourceGroup(covered_resources=["cpu"], flavors=[
+            FlavorQuotas(name="on-demand", resources={
+                "cpu": ResourceQuota(nominal=0)})])])
+        for n in ("b", "c")]
+    extra_lqs = (("eng-alpha", "lq-b", "b"), ("eng-alpha", "lq-c", "c"))
+    d, clock = fixture_driver(use_device, extra_cqs, extra_lqs,
+                              extra_cohorts=cohorts, fair_sharing=True)
+    admitted(d, "b0", "eng-alpha", "b",
+             [("one", 1, {"cpu": 10_000}, {"cpu": "on-demand"})])
+    pending(d, "b1", "eng-alpha", "lq-b", [("one", 1, {"cpu": 50_000})])
+    pending(d, "c1", "eng-alpha", "lq-c", [("one", 1, {"cpu": 75_000})])
+    stats = run_case(d, clock)
+    # b0+b1 = 60 < c1 = 75: b1 schedules first
+    assert set(stats.admitted) == {"eng-alpha/b1"}
+
+
+# --- :1816/:1870 FS priority and timestamp tie-breaks --------------------
+
+def _two_cq_cohort_driver(use_device):
+    cohorts = [Cohort(name="coh-a", resource_groups=[ResourceGroup(
+        covered_resources=["cpu"], flavors=[FlavorQuotas(
+            name="on-demand", resources={
+                "cpu": ResourceQuota(nominal=10_000)})])])]
+    extra_cqs = [ClusterQueue(
+        name=n, cohort="coh-a",
+        resource_groups=[ResourceGroup(covered_resources=["cpu"], flavors=[
+            FlavorQuotas(name="on-demand", resources={
+                "cpu": ResourceQuota(nominal=0)})])])
+        for n in ("b", "c")]
+    extra_lqs = (("eng-alpha", "lq-b", "b"), ("eng-alpha", "lq-c", "c"))
+    return fixture_driver(use_device, extra_cqs, extra_lqs,
+                          extra_cohorts=cohorts, fair_sharing=True)
+
+
+def test_fs_highest_priority_first(use_device):
+    d, clock = _two_cq_cohort_driver(use_device)
+    pending(d, "b1", "eng-alpha", "lq-b", [("one", 1, {"cpu": 10_000})],
+            priority=99)
+    pending(d, "c1", "eng-alpha", "lq-c", [("one", 1, {"cpu": 10_000})],
+            priority=101)
+    stats = run_case(d, clock)
+    assert set(stats.admitted) == {"eng-alpha/c1"}
+    heap, parked = queue_state(d, "b")
+    assert "eng-alpha/b1" in heap | parked
+
+
+def test_fs_earliest_timestamp_first(use_device):
+    d, clock = _two_cq_cohort_driver(use_device)
+    pending(d, "b1", "eng-alpha", "lq-b", [("one", 1, {"cpu": 10_000})],
+            priority=101, created=2.0)
+    pending(d, "c1", "eng-alpha", "lq-c", [("one", 1, {"cpu": 10_000})],
+            priority=101, created=1.0)
+    stats = run_case(d, clock)
+    assert set(stats.admitted) == {"eng-alpha/c1"}
+    heap, parked = queue_state(d, "b")
+    assert "eng-alpha/b1" in heap | parked
