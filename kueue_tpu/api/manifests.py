@@ -124,11 +124,10 @@ def _decode_cluster_queue(doc: dict) -> ClusterQueue:
                 pre.get("reclaimWithinCohort", "Never")),
             within_cluster_queue=WithinClusterQueue(
                 pre.get("withinClusterQueue", "Never")),
-            borrow_within_cohort=(BorrowWithinCohort(
+            borrow_within_cohort=BorrowWithinCohort(
                 policy=BorrowWithinCohortPolicy(
                     bwc.get("policy", "Never")),
-                max_priority_threshold=bwc.get("maxPriorityThreshold"))
-                if bwc else None)),
+                max_priority_threshold=bwc.get("maxPriorityThreshold"))),
         flavor_fungibility=FlavorFungibility(
             when_can_borrow=FlavorFungibilityPolicy(
                 ff.get("whenCanBorrow", "Borrow")),

@@ -113,9 +113,9 @@ def apply_spec(d: Driver, doc: dict) -> None:
         d.apply_local_queue(obj)
 
 
-def build_driver(store: Store) -> Driver:
+def build_driver(store: Store, use_device: bool = False) -> Driver:
     """Replay the store into a fresh Driver."""
-    d = Driver()
+    d = Driver(use_device_solver=use_device)
     order = ["ResourceFlavor", "Topology", "AdmissionCheck",
              "WorkloadPriorityClass", "Cohort", "ClusterQueue", "LocalQueue"]
     for kind in order:
@@ -307,7 +307,8 @@ def _set_stop_policy(store: Store, args, policy: StopPolicy) -> int:
 
 def cmd_schedule(store: Store, args) -> int:
     from .profiling import trace
-    driver = build_driver(store)
+    driver = build_driver(store, use_device=getattr(args, "device_solver",
+                                                    False))
     with trace(getattr(args, "profile_dir", None)):
         driver.run_until_settled(max_cycles=args.cycles)
     save_workloads(store, driver)
@@ -345,7 +346,8 @@ def cmd_serve(store: Store, args) -> int:
         if not lease.acquire(stop):
             return 0
     store = Store(args.state_dir)  # reload: the old leader wrote status
-    driver = build_driver(store)
+    driver = build_driver(store, use_device=getattr(args, "device_solver",
+                                                    False))
 
     from .debugger import Dumper
     dumper = Dumper(driver)
@@ -538,6 +540,8 @@ def main(argv: list[str] | None = None) -> int:
 
     p = sub.add_parser("schedule", help="run admission cycles")
     p.add_argument("--cycles", type=int, default=1000)
+    p.add_argument("--device-solver", action="store_true",
+                   help="decide cycles with the batched device solver")
     p.add_argument("--profile-dir", default=None,
                    help="write a jax.profiler trace here")
 
@@ -552,6 +556,8 @@ def main(argv: list[str] | None = None) -> int:
                    help="write a jax.profiler trace here")
     p.add_argument("--listen", type=int, default=None,
                    help="serve the MultiKueue worker API on this port")
+    p.add_argument("--device-solver", action="store_true",
+                   help="decide cycles with the batched device solver")
 
     p = sub.add_parser("import", help="bulk-import running pods")
     p.add_argument("-f", "--filename", required=True)

@@ -307,3 +307,38 @@ def test_driver_from_config():
     opts = d.cache.info_options
     assert opts.excluded_prefixes == ["example.com/"]
     assert "nvidia.com/mig-1g.5gb" in opts.transformations
+
+
+def test_cli_schedule_device_solver(tmp_path):
+    """--device-solver decides manifest-built clusters on the batched
+    path; regression for manifest-decoded CQs carrying
+    borrowWithinCohort=None into the packer."""
+    state = str(tmp_path / "state")
+    setup = tmp_path / "setup.yaml"
+    setup.write_text(SETUP_YAML + """
+---
+apiVersion: kueue.x-k8s.io/v1beta1
+kind: Workload
+metadata:
+  namespace: default
+  name: dev-job
+spec:
+  queueName: user-queue
+  podSets:
+  - name: main
+    count: 1
+    template:
+      spec:
+        containers:
+        - resources:
+            requests:
+              cpu: 2
+""")
+    assert main(["--state-dir", state, "apply", "-f", str(setup)]) == 0
+    assert main(["--state-dir", state, "schedule", "--device-solver",
+                 "--cycles", "5"]) == 0
+    store = Store(state)
+    doc = store.get("Workload", "dev-job")
+    conds = {c["type"]: c["status"]
+             for c in (doc.get("status") or {}).get("conditions", [])}
+    assert conds.get("QuotaReserved") == "True", doc
