@@ -73,7 +73,8 @@ class Cache:
         with self._lock:
             cq = self._mgr.cluster_queues.get(name)
             if cq is not None:
-                for key in cq.workloads:
+                for key, info in cq.workloads.items():
+                    self._tas_apply(info, -1)  # release domain capacity
                     self._wl_owner.pop(key, None)
             self._mgr.delete_cluster_queue(name)
             self._rebuild()
@@ -143,6 +144,39 @@ class Cache:
     def cluster_queue(self, name: str) -> Optional[CQState]:
         return self._mgr.cluster_queues.get(name)
 
+    def _tas_apply(self, info: Info, sign: int) -> None:
+        """Charge/release the workload's topology-domain usage in the
+        TAS cache (the reference tracks TAS usage alongside quota in
+        cache.AddOrUpdateWorkload; tas_cache usage feeds the per-cycle
+        TASFlavorSnapshot free capacity)."""
+        adm = info.obj.admission
+        if adm is None:
+            return
+        # per-pod values from the TRANSFORMED totals (workload.py applies
+        # resource transformations/exclusions) so charged usage matches
+        # what the assigner's _find_tas checks next cycle; total_requests
+        # already carries the implicit "pods" resource
+        by_name = {psr.name: psr for psr in info.total_requests}
+        for a in adm.pod_set_assignments:
+            ta = a.topology_assignment
+            if ta is None:
+                continue
+            flavor = next((f for f in a.flavors.values()
+                           if f in self.tas.flavors), None)
+            if flavor is None:
+                continue
+            psr = by_name.get(a.name)
+            if psr is None or psr.count <= 0:
+                continue
+            per_pod = {r: v // max(1, psr.count)
+                       for r, v in psr.requests.items()}
+            per_pod.setdefault("pods", 1)
+            for dom in ta.domains:
+                self.tas.add_usage(
+                    flavor, tuple(dom.values),
+                    {r: v * dom.count for r, v in per_pod.items()},
+                    sign)
+
     def add_or_update_workload(self, info: Info) -> bool:
         with self._lock:
             if info.obj.admission is None:
@@ -152,6 +186,7 @@ class Cache:
             # UpdateWorkload removes from the old CQ before adding).
             owner = self._find_owner(info)
             if owner is not None:
+                self._tas_apply(owner.workloads[info.key], -1)
                 owner.remove_workload(owner.workloads[info.key])
                 self._wl_owner.pop(info.key, None)
             cq = self._mgr.cluster_queues.get(info.obj.admission.cluster_queue)
@@ -160,6 +195,7 @@ class Cache:
                 return False
             info.cluster_queue = cq.name
             cq.add_workload(info)
+            self._tas_apply(info, +1)
             self._wl_owner[info.key] = cq.name
             self.assumed_workloads.discard(info.key)
             return True
@@ -168,6 +204,7 @@ class Cache:
         with self._lock:
             cq = self._find_owner(info)
             if cq is not None:
+                self._tas_apply(cq.workloads[info.key], -1)
                 cq.remove_workload(cq.workloads[info.key])
                 self._wl_owner.pop(info.key, None)
             self.assumed_workloads.discard(info.key)
@@ -185,6 +222,7 @@ class Cache:
                 return False
             info.cluster_queue = cq.name
             cq.add_workload(info)
+            self._tas_apply(info, +1)
             self._wl_owner[info.key] = cq.name
             self.assumed_workloads.add(info.key)
             return True
@@ -196,6 +234,7 @@ class Cache:
                 return False
             cq = self._find_owner(info)
             if cq is not None:
+                self._tas_apply(cq.workloads[info.key], -1)
                 cq.remove_workload(cq.workloads[info.key])
                 self._wl_owner.pop(info.key, None)
             self.assumed_workloads.discard(info.key)

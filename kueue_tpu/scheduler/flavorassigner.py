@@ -24,6 +24,7 @@ from ..api.types import (
     FlavorFungibilityPolicy,
     PodSet,
     PodSetAssignment,
+    PodSetTopologyRequest,
     ReclaimWithinCohort,
     ResourceFlavor,
     TopologyAssignment,
@@ -201,6 +202,23 @@ class FlavorAssigner:
         self.tas_flavors = tas_flavors or {}
         self.flavor_fungibility_enabled = flavor_fungibility_enabled
         self.tas_enabled = tas_enabled
+        self._tas_only: Optional[bool] = None
+
+    def _is_tas_only(self) -> bool:
+        """Every flavor of the CQ is a TAS flavor (reference
+        clusterqueue_snapshot.go:221 IsTASOnly): pod sets without a
+        topology request then get TAS implied (unconstrained)."""
+        if self._tas_only is None:
+            names = [fq.name for rg in self.cq.spec.resource_groups
+                     for fq in rg.flavors] if self.cq is not None else []
+            # every flavor must be a TAS flavor AND have topology data
+            # loaded — without snapshots, implying TAS would drive the
+            # whole CQ to NO_FIT (gate off / topology not yet cached)
+            self._tas_only = (self.tas_enabled and bool(names) and all(
+                (f := self.resource_flavors.get(n)) is not None
+                and f.topology_name and n in self.tas_flavors
+                for n in names))
+        return self._tas_only
 
     # ------------------------------------------------------------------
 
@@ -386,9 +404,26 @@ class FlavorAssigner:
 
     def _check_tas_match(self, pod_set: PodSet,
                          flavor: ResourceFlavor) -> Optional[str]:
-        """reference checkPodSetAndFlavorMatchForTAS."""
-        if pod_set.topology_request is not None and not flavor.topology_name:
-            return (f"Flavor {flavor.name} does not support "
+        """reference tas_flavorassigner.go:95
+        checkPodSetAndFlavorMatchForTAS."""
+        req = pod_set.topology_request
+        if req is not None:
+            if not flavor.topology_name:
+                return (f"Flavor {flavor.name} does not support "
+                        f"TopologyAwareScheduling")
+            snap = self.tas_flavors.get(flavor.name)
+            if snap is None:
+                return (f"Flavor {flavor.name} information missing in "
+                        f"TAS cache")
+            for level in (req.required, req.preferred):
+                if level is not None and level not in snap.levels:
+                    return (f"Flavor {flavor.name} does not contain the "
+                            f"requested level")
+            return None
+        if self._is_tas_only():
+            return None   # TAS implied (unconstrained) on a TAS-only CQ
+        if flavor.topology_name:
+            return (f"Flavor {flavor.name} supports only "
                     f"TopologyAwareScheduling")
         return None
 
@@ -433,7 +468,8 @@ class FlavorAssigner:
 
     def _apply_tas(self, assignment: Assignment,
                    requests: list[PodSetResources]) -> None:
-        if not any(psr.topology_request is not None for psr in requests):
+        if (not any(psr.topology_request is not None for psr in requests)
+                and not self._is_tas_only()):
             return
         if assignment.representative_mode() == Mode.FIT:
             ok = self._find_tas(assignment, requests, simulate_empty=False)
@@ -447,10 +483,16 @@ class FlavorAssigner:
     def _find_tas(self, assignment: Assignment,
                   requests: list[PodSetResources],
                   simulate_empty: bool, record: bool = True) -> bool:
+        implied = self._is_tas_only()
         assumed: dict[str, dict[tuple, dict[str, int]]] = {}
         for psr, ps_result in zip(requests, assignment.pod_sets):
-            if psr.topology_request is None:
-                continue
+            request = psr.topology_request
+            if request is None:
+                if not implied:
+                    continue
+                # TAS-only CQ: implied unconstrained placement
+                # (tas_flavorassigner.go:126 isTASImplied)
+                request = PodSetTopologyRequest(unconstrained=True)
             flavor_names = {fa.name for fa in ps_result.flavors.values()}
             if not flavor_names:
                 continue
@@ -463,7 +505,7 @@ class FlavorAssigner:
             per_pod = ({r: v // max(1, psr.count)
                         for r, v in psr.requests.items()})
             tas_assignment, reason = snap.find_topology_assignment(
-                psr.count, per_pod, psr.topology_request,
+                psr.count, per_pod, request,
                 assumed=None if simulate_empty else assumed.get(f_name))
             if tas_assignment is None:
                 ps_result.reasons.append(reason)

@@ -592,10 +592,12 @@ class Scheduler:
         """reference scheduler.go:415 getAssignments."""
         cq = snapshot.cq(wl.cluster_queue)
         oracle = PreemptionOracle(self.preemptor, snapshot)
+        from .. import features
         assigner = FlavorAssigner(
             wl, cq, snapshot.resource_flavors,
             enable_fair_sharing=self.fair_sharing, oracle=oracle,
-            tas_flavors=snapshot.tas_flavors)
+            tas_flavors=snapshot.tas_flavors,
+            tas_enabled=features.enabled("TopologyAwareScheduling"))
         full = assigner.assign(None)
         mode = full.representative_mode()
         if mode == Mode.FIT:

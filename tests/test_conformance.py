@@ -612,3 +612,145 @@ def test_fs_earliest_timestamp_first(use_device):
     assert set(stats.admitted) == {"eng-alpha/c1"}
     heap, parked = queue_state(d, "b")
     assert "eng-alpha/b1" in heap | parked
+
+
+# --- TestScheduleForTAS (scheduler_test.go:4222+) ------------------------
+
+HOSTNAME = "kubernetes.io/hostname"
+
+
+@pytest.fixture(autouse=True)
+def _reset_tas_gate():
+    yield
+    from kueue_tpu import features
+    features.set_feature_gates({"TopologyAwareScheduling": False})
+
+
+def tas_driver(use_device, cq_flavors):
+    """The TestScheduleForTAS fixture: one node x1 (1 cpu / 1Gi / 10
+    pods), single-level topology over the hostname label, a TAS flavor
+    selecting tas-node=true, and a non-TAS 'default' flavor."""
+    from kueue_tpu import features
+    from kueue_tpu.api.types import Topology
+    from kueue_tpu.cache.tas_cache import NodeInfo
+    features.set_feature_gates({"TopologyAwareScheduling": True})
+    clock = FakeClock()
+    d = Driver(clock=clock, use_device_solver=use_device,
+               solver_backend="cpu" if use_device else "auto")
+    d.apply_topology(Topology(name="tas-single-level", levels=[HOSTNAME]))
+    d.apply_resource_flavor(ResourceFlavor(
+        name="tas-default", node_labels={"tas-node": "true"},
+        topology_name="tas-single-level"))
+    d.apply_resource_flavor(ResourceFlavor(name="default"))
+    d.cache.tas.add_or_update_node(NodeInfo(
+        name="x1", labels={"tas-node": "true", HOSTNAME: "x1"},
+        capacity={"cpu": 1000, "memory": 1 << 30, "pods": 10}))
+    d.apply_cluster_queue(ClusterQueue(
+        name="tas-main", resource_groups=[ResourceGroup(
+            covered_resources=["cpu"],
+            flavors=[FlavorQuotas(name=f, resources={
+                "cpu": ResourceQuota(nominal=50_000)})
+                for f in cq_flavors])]))
+    d.apply_local_queue(LocalQueue(name="tas-main", cluster_queue="tas-main"))
+    return d, clock
+
+
+def tas_assignment_of(d, key):
+    wl = d.workload(key)
+    a = wl.admission.pod_set_assignments[0]
+    ta = a.topology_assignment
+    return (dict(a.flavors),
+            None if ta is None else (tuple(ta.levels),
+                                     tuple((tuple(dom.values), dom.count)
+                                           for dom in ta.domains)))
+
+
+def test_tas_implied_on_tas_only_cq(use_device):
+    """:4288 — no TAS annotation, only-TAS-flavor CQ: admitted on the
+    TAS flavor WITH an (implied, unconstrained) topology assignment."""
+    d, clock = tas_driver(use_device, ["tas-default"])
+    pending(d, "foo", "default", "tas-main", [("one", 1, {"cpu": 1000})])
+    stats = run_case(d, clock)
+    assert set(stats.admitted) == {"default/foo"}
+    flavors, ta = tas_assignment_of(d, "default/foo")
+    assert flavors == {"cpu": "tas-default"}
+    assert ta == ((HOSTNAME,), ((("x1",), 1),))
+
+
+def test_tas_request_skips_non_tas_flavor(use_device):
+    """:4337 — required hostname placement skips the non-TAS flavor."""
+    d, clock = tas_driver(use_device, ["default", "tas-default"])
+    seq = len(d.workloads) + 1
+    d.create_workload(Workload(
+        name="foo", namespace="default", queue_name="tas-main",
+        creation_time=float(seq),
+        pod_sets=[PodSet(name="one", count=1, requests={"cpu": 1000},
+                         topology_request=__import__(
+                             "kueue_tpu.api.types", fromlist=["x"]
+                         ).PodSetTopologyRequest(required=HOSTNAME))]))
+    stats = run_case(d, clock)
+    assert set(stats.admitted) == {"default/foo"}
+    flavors, ta = tas_assignment_of(d, "default/foo")
+    assert flavors == {"cpu": "tas-default"}
+    assert ta == ((HOSTNAME,), ((("x1",), 1),))
+
+
+def test_non_tas_workload_skips_tas_flavor(use_device):
+    """:4389 — no TAS annotation with a non-TAS alternative available:
+    the TAS flavor is skipped and no topology assignment is attached."""
+    d, clock = tas_driver(use_device, ["tas-default", "default"])
+    pending(d, "foo", "default", "tas-main", [("one", 1, {"cpu": 1000})])
+    stats = run_case(d, clock)
+    assert set(stats.admitted) == {"default/foo"}
+    flavors, ta = tas_assignment_of(d, "default/foo")
+    assert flavors == {"cpu": "default"}
+    assert ta is None
+
+
+def test_tas_workload_exceeds_node_capacity(use_device):
+    """:4648 — 2 pods x 1 cpu against a 1-cpu node: inadmissible."""
+    from kueue_tpu.api.types import PodSetTopologyRequest
+    d, clock = tas_driver(use_device, ["tas-default"])
+    seq = len(d.workloads) + 1
+    d.create_workload(Workload(
+        name="foo", namespace="default", queue_name="tas-main",
+        creation_time=float(seq),
+        pod_sets=[PodSet(name="one", count=2, requests={"cpu": 1000},
+                         topology_request=PodSetTopologyRequest(
+                             required=HOSTNAME))]))
+    stats = run_case(d, clock)
+    assert not stats.admitted
+    heap, parked = queue_state(d, "tas-main")
+    assert "default/foo" in heap | parked
+
+
+def test_tas_capacity_consumed_by_admitted_workload(use_device):
+    """:4674 — the node's capacity is already held by an admitted TAS
+    workload: the pending one is inadmissible despite free CQ quota."""
+    from kueue_tpu.api.types import (PodSetTopologyRequest,
+                                     TopologyAssignment,
+                                     TopologyDomainAssignment)
+    d, clock = tas_driver(use_device, ["tas-default"])
+    wl = Workload(
+        name="bar-admitted", namespace="default", queue_name="tas-main",
+        creation_time=0.5,
+        pod_sets=[PodSet(name="one", count=1, requests={"cpu": 1000},
+                         topology_request=PodSetTopologyRequest(
+                             required=HOSTNAME))])
+    adm = Admission(cluster_queue="tas-main", pod_set_assignments=[
+        PodSetAssignment(
+            name="one", flavors={"cpu": "tas-default"},
+            resource_usage={"cpu": 1000}, count=1,
+            topology_assignment=TopologyAssignment(
+                levels=[HOSTNAME],
+                domains=[TopologyDomainAssignment(values=["x1"],
+                                                  count=1)]))])
+    set_quota_reservation(wl, adm, 0.5)
+    sync_admitted_condition(wl, 0.5)
+    d.restore_workload(wl)
+    pending(d, "foo", "default", "tas-main", [("one", 1, {"cpu": 1000})])
+    # implied TAS on the TAS-only CQ must see x1's cpu fully consumed
+    stats = run_case(d, clock)
+    assert not stats.admitted, stats
+    heap, parked = queue_state(d, "tas-main")
+    assert "default/foo" in heap | parked
