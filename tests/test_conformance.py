@@ -754,3 +754,104 @@ def test_tas_capacity_consumed_by_admitted_workload(use_device):
     assert not stats.admitted, stats
     heap, parked = queue_state(d, "tas-main")
     assert "default/foo" in heap | parked
+
+
+# --- :2127+ multiple preemptions in one cycle ----------------------------
+
+def _pre_cq(name, cohort, nominal_cpu, extra_res=None,
+            reclaim=ReclaimWithinCohort.NEVER):
+    resources = {"cpu": ResourceQuota(nominal=nominal_cpu)}
+    covered = ["cpu"]
+    for rname, q in (extra_res or {}).items():
+        resources[rname] = ResourceQuota(nominal=q)
+        covered.append(rname)
+    return ClusterQueue(
+        name=name, cohort=cohort,
+        preemption=PreemptionPolicy(
+            reclaim_within_cohort=reclaim,
+            within_cluster_queue=WithinClusterQueue.LOWER_PRIORITY),
+        resource_groups=[ResourceGroup(covered_resources=covered, flavors=[
+            FlavorQuotas(name="default", resources=resources)])])
+
+
+def test_multiple_preemptions_without_borrowing(use_device):
+    """:2127 — two CQs preempt within themselves in the SAME cycle."""
+    extra_cqs = [_pre_cq("other-alpha", "other", 2000),
+                 _pre_cq("other-beta", "other", 2000)]
+    extra_lqs = (("eng-alpha", "other", "other-alpha"),
+                 ("eng-beta", "other", "other-beta"))
+    d, clock = fixture_driver(use_device, extra_cqs, extra_lqs)
+    admitted(d, "a1", "eng-alpha", "other-alpha",
+             [("main", 1, {"cpu": 2000}, {"cpu": "default"})], priority=0)
+    admitted(d, "b1", "eng-beta", "other-beta",
+             [("main", 1, {"cpu": 2000}, {"cpu": "default"})], priority=0)
+    pending(d, "preemptor", "eng-alpha", "other",
+            [("main", 1, {"cpu": 2000})], priority=100)
+    pending(d, "preemptor", "eng-beta", "other",
+            [("main", 1, {"cpu": 2000})], priority=100)
+    stats = run_case(d, clock)
+    assert set(stats.preempted_targets) == {"eng-alpha/a1", "eng-beta/b1"}
+    assert set(stats.preempting) == {"eng-alpha/preemptor",
+                                     "eng-beta/preemptor"}
+    assert not stats.admitted
+
+
+def test_preemption_possible_after_earlier_fit(use_device):
+    """:2195 — a Fit workload earlier in the cycle doesn't block a
+    preempting workload in the same cycle."""
+    extra_cqs = [_pre_cq("other-alpha", "other", 1000),
+                 _pre_cq("other-beta", "other", 2000)]
+    extra_lqs = (("eng-alpha", "other", "other-alpha"),
+                 ("eng-beta", "other", "other-beta"))
+    d, clock = fixture_driver(use_device, extra_cqs, extra_lqs)
+    admitted(d, "b1", "eng-beta", "other-beta",
+             [("main", 1, {"cpu": 2000}, {"cpu": "default"})], priority=0)
+    pending(d, "fit", "eng-alpha", "other", [("main", 1, {"cpu": 1000})],
+            priority=100)
+    pending(d, "preemptor", "eng-beta", "other",
+            [("main", 1, {"cpu": 2000})], priority=99)
+    stats = run_case(d, clock)
+    assert set(stats.admitted) == {"eng-alpha/fit"}
+    assert set(stats.preempted_targets) == {"eng-beta/b1"}
+    assert flavors_of(d, "eng-alpha/fit") == {"main": {"cpu": "default"}}
+
+
+def test_skip_overlapping_preemption_targets(use_device):
+    """:2453 — two preemptors need the same over-share target; only the
+    higher-priority one preempts, the other is skipped (fair sharing)."""
+    # the reference case's CQs leave ReclaimWithinCohort un-defaulted
+    # (its unit harness skips webhook defaulting; the empty value is NOT
+    # "Never"), effectively enabling lower-priority cohort reclaim —
+    # expressed here explicitly
+    lp = ReclaimWithinCohort.LOWER_PRIORITY
+    extra_cqs = [
+        _pre_cq("other-alpha", "other", 0, {"alpha-resource": 1}, lp),
+        _pre_cq("other-beta", "other", 0, {"beta-resource": 1}, lp),
+        _pre_cq("other-gamma", "other", 0, {"gamma-resource": 1}, lp),
+        ClusterQueue(name="resource-bank", cohort="other",
+                     resource_groups=[ResourceGroup(
+                         covered_resources=["cpu"],
+                         flavors=[FlavorQuotas(name="default", resources={
+                             "cpu": ResourceQuota(nominal=9000)})])]),
+    ]
+    extra_lqs = (("eng-alpha", "other", "other-alpha"),
+                 ("eng-beta", "other", "other-beta"),
+                 ("eng-gamma", "other", "other-gamma"))
+    d, clock = fixture_driver(use_device, extra_cqs, extra_lqs,
+                              fair_sharing=True)
+    admitted(d, "a1", "eng-alpha", "other-alpha",
+             [("main", 1, {"alpha-resource": 1}, {"alpha-resource": "default"})],
+             priority=0)
+    admitted(d, "b1", "eng-beta", "other-beta",
+             [("main", 1, {"beta-resource": 1}, {"beta-resource": "default"})],
+             priority=0)
+    admitted(d, "c1", "eng-gamma", "other-gamma",
+             [("main", 1, {"cpu": 9000}, {"cpu": "default"})], priority=0)
+    pending(d, "preemptor", "eng-alpha", "other",
+            [("main", 1, {"cpu": 3000, "alpha-resource": 1})], priority=100)
+    pending(d, "pretending-preemptor", "eng-beta", "other",
+            [("main", 1, {"cpu": 3000, "beta-resource": 1})], priority=99)
+    stats = run_case(d, clock)
+    assert set(stats.preempted_targets) == {"eng-alpha/a1", "eng-gamma/c1"}
+    assert set(stats.preempting) == {"eng-alpha/preemptor"}
+    assert not stats.admitted
