@@ -855,3 +855,51 @@ def test_skip_overlapping_preemption_targets(use_device):
     assert set(stats.preempted_targets) == {"eng-alpha/a1", "eng-gamma/c1"}
     assert set(stats.preempting) == {"eng-alpha/preemptor"}
     assert not stats.admitted
+
+
+def test_minimal_preemptions_target_queue_exhausted(use_device):
+    """:1926 — incoming needs 2; its CQ is exhausted by its own lower-
+    priority workloads: minimal preemption evicts exactly a1+a2 (the two
+    lowest) and never touches the other CQs' equal-priority workloads."""
+    reclaim = ReclaimWithinCohort.ANY
+    extra_cqs = [_pre_cq("other-alpha", "other", 2000, reclaim=reclaim),
+                 _pre_cq("other-beta", "other", 2000),
+                 _pre_cq("other-gamma", "other", 2000)]
+    extra_lqs = (("eng-alpha", "other", "other-alpha"),
+                 ("eng-beta", "other", "other-beta"),
+                 ("eng-gamma", "other", "other-gamma"))
+    d, clock = fixture_driver(use_device, extra_cqs, extra_lqs)
+    for name, prio in (("a1", -2), ("a2", -2), ("a3", -1)):
+        admitted(d, name, "eng-alpha", "other-alpha",
+                 [("main", 1, {"cpu": 1000}, {"cpu": "default"})],
+                 priority=prio)
+    for name in ("b1", "b2", "b3"):
+        admitted(d, name, "eng-beta", "other-beta",
+                 [("main", 1, {"cpu": 1000}, {"cpu": "default"})],
+                 priority=0)
+    pending(d, "incoming", "eng-alpha", "other",
+            [("main", 1, {"cpu": 2000})], priority=0)
+    stats = run_case(d, clock)
+    assert set(stats.preempted_targets) == {"eng-alpha/a1", "eng-alpha/a2"}
+    assert set(stats.preempting) == {"eng-alpha/incoming"}
+
+
+def test_preemption_eligible_only_within_nominal(use_device):
+    """:2015 — incoming (3 cpu) exceeds its CQ's 2-cpu nominal: not
+    eligible to preempt at all; it parks inadmissible."""
+    extra_cqs = [_pre_cq("other-alpha", "other", 2000,
+                         reclaim=ReclaimWithinCohort.ANY),
+                 _pre_cq("other-beta", "other", 2000)]
+    extra_lqs = (("eng-alpha", "other", "other-alpha"),
+                 ("eng-beta", "other", "other-beta"))
+    d, clock = fixture_driver(use_device, extra_cqs, extra_lqs)
+    admitted(d, "a1", "eng-alpha", "other-alpha",
+             [("main", 1, {"cpu": 1000}, {"cpu": "default"})], priority=-1)
+    admitted(d, "b1", "eng-beta", "other-beta",
+             [("main", 1, {"cpu": 1000}, {"cpu": "default"})], priority=-1)
+    pending(d, "incoming", "eng-alpha", "other",
+            [("main", 1, {"cpu": 3000})], priority=1)
+    stats = run_case(d, clock)
+    assert not stats.admitted and not stats.preempting, stats
+    heap, parked = queue_state(d, "other-alpha")
+    assert "eng-alpha/incoming" in heap | parked
