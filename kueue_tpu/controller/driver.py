@@ -104,6 +104,7 @@ class Driver:
         self.events: list[tuple[str, str, str]] = []  # (kind, key, note)
         self.metrics = metrics.Registry()
         self.scheduler.metrics = self.metrics
+        self._burst_solver = None   # lazy BurstSolver (ops/burst.py)
 
     @classmethod
     def from_config(cls, cfg, clock: Callable[[], float] = time.time,
@@ -591,6 +592,221 @@ class Driver:
         stats = self.scheduler.schedule()
         self.metrics.admission_attempt(bool(stats.admitted), stats.duration_s)
         return stats
+
+    def schedule_burst(self, max_cycles: int, runtime: int = 0,
+                       external_finishes: Optional[dict] = None,
+                       on_cycle: Optional[Callable] = None,
+                       on_cycle_start: Optional[Callable] = None,
+                       backend: str = "auto") -> list:
+        """Run up to ``max_cycles`` cycles, fusing runs of clean cycles
+        into single device dispatches (kueue_tpu.ops.burst) and falling
+        back to the normal per-cycle path whenever a cycle needs host
+        semantics (preemption, scalar heads) or the modeled heads diverge
+        from the live queues.
+
+        ``runtime`` > 0 models fake execution: a workload admitted at
+        applied-cycle j is finished at cycle j+runtime (the perf
+        harness's contract — reference runner/controller/controller.go
+        :113).  ``external_finishes`` maps cycle offsets (relative to
+        this call) to workload keys admitted BEFORE the call that finish
+        at that offset; the driver performs both kinds of finishes
+        itself.  ``on_cycle_start(k)`` / ``on_cycle(k, stats)`` bracket
+        each applied cycle (clock advancement, bookkeeping).
+
+        Returns the list of per-cycle CycleStats actually applied."""
+        import numpy as np
+        from ..ops.burst import BurstSolver, pack_burst, K_BURST_LADDER
+
+        ext = {int(k): list(v) for k, v in
+               (external_finishes or {}).items()}
+        out: list = []
+        burst_ineligible = (
+            self.scheduler.fair_sharing
+            or (self.wait_for_pods_ready.enable
+                and self.wait_for_pods_ready.block_admission))
+        if self._burst_solver is None:
+            self._burst_solver = BurstSolver(backend=backend)
+        self._burst_solver.backend = backend
+        solver = self.scheduler.solver
+        normal_streak = 0   # cycles to run normally before re-bursting
+
+        def finish_cycle(stats) -> None:
+            """Record one applied cycle + its end-of-cycle finishes."""
+            k = len(out)
+            out.append(stats)
+            for key in ext.pop(k, []):
+                self.finish_workload(key)
+            if runtime > 0 and k - runtime >= 0:
+                for key in out[k - runtime].admitted:
+                    wl = self.workloads.get(key)
+                    if wl is not None and wl.has_quota_reservation:
+                        self.finish_workload(key)
+            if on_cycle is not None:
+                on_cycle(k, stats)
+
+        def quiescent() -> bool:
+            """Nothing can make further cycles non-empty: no eligible
+            heads now, no pending backoff timer, and no future finish
+            (external or modeled-runtime) that could unpark work."""
+            if any(off >= len(out) for off in ext):
+                return False
+            if runtime > 0 and any(
+                    out[j].admitted for j in
+                    range(max(0, len(out) - runtime), len(out))):
+                return False
+            for name in self.queues.cluster_queue_names():
+                q = self.queues.queue_for(name)
+                if q is None or not q.active:
+                    continue
+                if len(q.heap):
+                    return False     # a head exists right now
+                for info in q.inadmissible.values():
+                    rs = info.obj.requeue_state
+                    if rs is not None and rs.requeue_at is not None:
+                        return False  # a backoff timer will fire
+            return True
+
+        def normal_cycle(heads=None, advance=True) -> bool:
+            """One normal-path cycle; False when the queues were empty."""
+            if advance and on_cycle_start is not None:
+                on_cycle_start(len(out))
+            if heads is None:
+                stats = self.schedule_once()
+            else:
+                stats = self.scheduler.schedule(heads=heads)
+                self.metrics.admission_attempt(bool(stats.admitted),
+                                               stats.duration_s)
+            finish_cycle(stats)
+            return bool(stats.admitted or stats.skipped
+                        or stats.inadmissible or stats.preempting)
+
+        dirty_backoff = 0
+        while len(out) < max_cycles:
+            if burst_ineligible or solver is None or normal_streak > 0:
+                normal_streak = max(0, normal_streak - 1)
+                if not normal_cycle() and quiescent():
+                    break
+                continue
+            snapshot = self.cache.snapshot()
+            st = solver._structure_for(snapshot, [])
+            plan = pack_burst(st, self.queues, self.cache,
+                              self.scheduler, self.clock)
+            if plan is None:
+                if not normal_cycle() and quiescent():
+                    break
+                continue
+            remaining = max_cycles - len(out)
+            K = next((r for r in K_BURST_LADDER if r >= min(
+                remaining, K_BURST_LADDER[-1])), K_BURST_LADDER[-1])
+            F = max(1, len(st.fr_index))
+            ext_release = np.zeros((K, plan.C, F), dtype=np.int32)
+            ext_unpark = np.zeros((K, plan.G), dtype=bool)
+            # the kernel must model EVERY release during its window: the
+            # caller's external schedule plus the still-pending modeled
+            # finishes of cycles applied earlier in this call (a re-pack
+            # after truncation starts a fresh release ring)
+            sched = {k: list(v) for k, v in ext.items()}
+            if runtime > 0:
+                for j in range(max(0, len(out) - runtime), len(out)):
+                    due = j + runtime
+                    keys = [key for key in out[j].admitted
+                            if (wl := self.workloads.get(key)) is not None
+                            and wl.has_quota_reservation]
+                    if keys:
+                        sched.setdefault(due, []).extend(keys)
+            if not self._fill_ext_release(st, plan, sched, len(out), K,
+                                          ext_release, ext_unpark):
+                if not normal_cycle() and quiescent():
+                    break
+                continue
+            head_row, admitted, fit_slot, borrows, _park, dirty, _ = (
+                self._burst_solver.run(plan, K, runtime, ext_release,
+                                       ext_unpark))
+            applied = 0
+            drained = False
+            for k in range(K):
+                if len(out) >= max_cycles:
+                    break
+                modeled: dict = {}
+                for ci in np.nonzero(head_row[k] >= 0)[0]:
+                    key = plan.keys[ci][int(head_row[k, ci])]
+                    if admitted[k, ci]:
+                        kind = "admit"
+                    elif fit_slot[k, ci] >= 0:
+                        kind = "skip"
+                    else:
+                        kind = "park"
+                    modeled[key] = (kind, int(fit_slot[k, ci]),
+                                    bool(borrows[k, ci]))
+                if not dirty[k] and not modeled and quiescent():
+                    drained = True
+                    break
+                # the cycle boundary in schedule_once order: advance the
+                # caller's clock FIRST, then fire deadline/backoff timers
+                # at the new time, then pop heads
+                if on_cycle_start is not None:
+                    on_cycle_start(len(out))
+                if self.wait_for_pods_ready.enable:
+                    self.enforce_wait_for_pods_ready()
+                self.queues.wake_expired_backoffs()
+                heads = self.queues.heads_nonblocking()
+                if dirty[k]:
+                    normal_cycle(heads=heads, advance=False)
+                    if applied == 0:
+                        dirty_backoff = min(8, max(1, 2 * dirty_backoff))
+                        normal_streak = dirty_backoff
+                    break   # kernel state is stale past a host cycle
+                if {h.key for h in heads} != set(modeled):
+                    # unmodeled divergence: decide this cycle normally
+                    normal_cycle(heads=heads, advance=False)
+                    break
+                if not modeled:
+                    # empty cycle: pending finishes may unpark work
+                    normal_cycle(heads=[], advance=False)
+                    continue
+                stats = self.scheduler.apply_burst_cycle(heads, modeled)
+                self.metrics.admission_attempt(bool(stats.admitted),
+                                               stats.duration_s)
+                finish_cycle(stats)
+                applied += 1
+                normal_streak = 0
+                dirty_backoff = 0
+            if drained:
+                break
+        return out
+
+    def _fill_ext_release(self, st, plan, ext: dict, base: int, K: int,
+                          ext_release, ext_unpark) -> bool:
+        """Scale the external finish schedule into [K, C, F] release
+        tensors.  False when a release isn't representable (fall back to
+        normal cycles)."""
+        from ..workload import Info
+        scale_of = {r: int(st.resource_scale[i])
+                    for i, r in enumerate(st.resource_names)}
+        for off, keys in ext.items():
+            k = off - base
+            if k < 0:
+                continue
+            if k >= K:
+                continue
+            for key in keys:
+                wl = self.workloads.get(key)
+                if wl is None or wl.admission is None:
+                    continue
+                ci = st.cq_index.get(wl.admission.cluster_queue)
+                if ci is None:
+                    return False
+                info = Info(wl, self.cache.info_options)
+                for fr, v in info.usage().items():
+                    fi = st.fr_index.get(fr)
+                    if fi is None:
+                        return False
+                    s = scale_of.get(fr.resource)
+                    if s is None or v % s:
+                        return False
+                    ext_release[k, ci, fi] += v // s
+                ext_unpark[k, int(plan.arrays["forest_of_cq"][ci])] = True
+        return True
 
     def run(self, stop_event, heads_timeout: float = 0.2) -> None:
         """Daemon mode: the long-running admission loop over blocking

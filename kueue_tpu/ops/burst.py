@@ -1,0 +1,654 @@
+"""Fused multi-cycle admission bursts: K scheduling cycles in ONE dispatch.
+
+Round 3 measured why the accelerator never ran a production cycle: one
+dispatch through this environment's tunnel costs ~112 ms flat, more than
+an entire XLA-CPU cycle at the north-star shape, so the calibrated
+per-cycle router correctly starved the chip.  The fix is architectural,
+not a tuning knob: keep the WHOLE pending set on the device (not just the
+cycle heads) and fuse K successive cycles — head selection + classify +
+admit scan + usage release + re-heads — into one jitted program, so the
+dispatch cost is paid once per K cycles (verdict r3 item 1; reference hot
+loop scheduler.go:176-302).
+
+Semantics reproduced per fused cycle, bit-matching the host scheduler:
+
+1. **Heads** (queue/manager.go:586 Heads): the top of every CQ's heap —
+   here an argmin over a dense per-CQ rank matrix.  Ranks are
+   host-precomputed with the exact heap comparator (priority desc,
+   queue-order timestamp asc, key asc — cluster_queue.go:408); they are
+   static within a burst because priorities/timestamps never change
+   without an external event, and external events end the burst.
+2. **Classify** (flavorassigner.go:499): the vectorized nominate of
+   ops.cycle.classify_np, evaluated dense over [C, S, R].
+3. **Cycle order** (scheduler.go:567 entryOrdering): borrows asc, then a
+   host-precomputed (priority desc, timestamp asc, heads-position) rank.
+4. **Admit scan** (scheduler.go:211-284): forest-parallel — one head per
+   cohort forest per step, fits re-checked chain-locally, usage charged
+   up the ancestor chain (the ops.cycle.admit_scan_forests discipline).
+5. **Requeue semantics** (cluster_queue.go:225): a NoFit head parks in
+   the inadmissible lot (BestEffortFIFO) or stays eligible (StrictFIFO);
+   a fit head that lost capacity in-scan requeues immediately (stays
+   eligible) — FAILED_AFTER_NOMINATION is immediate on both strategies.
+6. **Finish + unpark** (driver.finish_workload → manager.go:490
+   QueueInadmissibleWorkloads): quota released at end-of-cycle unparks
+   every CQ in the affected cohort forest.  Releases come from two
+   sources: workloads admitted IN the burst finishing ``runtime`` cycles
+   later (the perf harness's fake execution — reference
+   runner/controller/controller.go:113), and an external release
+   schedule for workloads admitted before the burst.
+
+Anything the fused math can't decide bit-identically makes the cycle
+**dirty**: a preempt-capable head (needs the host preemption search), a
+head outside the vectorized classify's coverage (multi-RG / multi-PodSet
+/ taints / TAS / partial admission — ``vec_ok`` False), or a head with
+fungibility resume state.  The kernel reports the first dirty cycle and
+the host applies only the clean prefix, running the normal per-cycle
+path from there.  Decisions are additionally validated on application:
+the driver compares each cycle's modeled heads against the live queues
+and truncates on any divergence, so burst mode can never corrupt state
+even under unmodeled events.
+
+Usage invariant that makes device-resident state exact: for every cohort
+node, ``usage[node] == Σ_children max(0, usage[child] - guaranteed
+[child])`` (resource_node.go:123-144 add/remove bubbling preserves it, by
+induction).  The kernel therefore keeps only CQ-level usage as ground
+truth and rebuilds cohort rows level-by-level each cycle — releases need
+no sequential remove-chain walks.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from .quota_kernel import available_all, available_at
+from .cycle import add_usage_chain_batched
+
+INF_I32 = np.int32(2**31 - 1)
+I32_MAX = 2**31 - 1
+# composite in-forest ordering key: borrows (entryOrdering's primary) in
+# bit 30, the host-precomputed (priority, timestamp, position) rank below
+_BORROW_BIT = np.int32(1 << 30)
+
+
+# ----------------------------------------------------------------------
+# The fused kernel
+# ----------------------------------------------------------------------
+
+@partial(
+    jax.jit,
+    static_argnames=("K", "depth", "L", "S", "RTP", "n_levels", "G",
+                     "runtime"))
+def burst_cycles(
+    # dense workload state [C, M, ...]
+    wl_req,          # [C, M, R] int32 scaled requests
+    wl_rank,         # [C, M] int32 heap rank (INF_I32 = empty slot)
+    wl_cycle_rank,   # [C, M] int32 global (priority, ts, pos) rank
+    vec_ok,          # [C, M] bool  vectorized-classify coverage
+    elig0,           # [C, M] bool  in the heap at burst start
+    parked0,         # [C, M] bool  in the inadmissible lot at burst start
+    resume0,         # [C, M] bool  fungibility resume state pending
+    # quota plane
+    u_cq0,           # [C, F] int32 CQ-level usage at burst start
+    potential0,      # [N, F] int32 available() at zero usage (static)
+    # structure (PackedStructure tensors)
+    subtree, guaranteed, borrow_cap, has_blim,   # [N, F]
+    parent,          # [N] int32
+    node_level,      # [N] int32 (roots = 0)
+    nominal_cq,      # [C, F]
+    slot_fr,         # [C, S, R] int32 F-index or -1
+    slot_valid,      # [C, S] bool
+    cq_can_preempt_borrow,                       # [C] bool
+    forest_of_cq,    # [C] int32
+    strict_cq,       # [C] bool StrictFIFO
+    members,         # [G, L] int32 CQ indices per forest (-1 pad, static)
+    # event schedule
+    ext_release,     # [K, C, F] int32 usage released at END of cycle k
+    ext_unpark,      # [K, G] bool forest unpark events at END of cycle k
+    *, K: int, depth: int, L: int, S: int, RTP: int, n_levels: int,
+    G: int, runtime: int,
+):
+    """Run K fused admission cycles.  Returns per-cycle (head_row[K,C],
+    admitted[K,C], fit_slot[K,C], borrows[K,C], parked_new[K,C],
+    dirty[K]) plus the final u_cq."""
+    C, M, R = wl_req.shape
+    N, F = subtree.shape
+    cidx = jnp.arange(C, dtype=jnp.int32)
+    has_parent_cq = parent[:C] >= 0
+
+    def rebuild_usage(u_cq):
+        """CQ usage → full node usage via the subtree invariant."""
+        usage = jnp.zeros((N, F), dtype=jnp.int32).at[:C].set(u_cq)
+        parent_safe = jnp.maximum(parent, 0)
+        for lvl in range(n_levels - 1, 0, -1):
+            is_l = (node_level == lvl) & (parent >= 0)
+            contrib = jnp.where(is_l[:, None],
+                                jnp.maximum(0, usage - guaranteed), 0)
+            usage = usage.at[parent_safe].add(contrib)
+        return usage
+
+    def cycle(carry, k):
+        elig, parked, resume, u_cq, rel = carry
+        usage = rebuild_usage(u_cq)
+        avail = available_all(usage, subtree, guaranteed, borrow_cap,
+                              has_blim, parent, depth)
+
+        # -- heads: argmin heap rank per CQ ---------------------------
+        key = jnp.where(elig, wl_rank, INF_I32)
+        row = jnp.argmin(key, axis=1).astype(jnp.int32)        # [C]
+        has_head = key[cidx, row] < INF_I32
+        req = wl_req[cidx, row]                                # [C, R]
+
+        # -- classify (classify_np dense twin) ------------------------
+        frs = slot_fr                                          # [C,S,R]
+        frs_safe = jnp.maximum(frs, 0)
+        covered = frs >= 0
+        needed = req[:, None, :] > 0
+        missing = jnp.any(needed & ~covered, axis=2)           # [C,S]
+        av = avail[:C][cidx[:, None, None], frs_safe]          # [C,S,R]
+        pot = potential0[:C][cidx[:, None, None], frs_safe]
+        nom = nominal_cq[cidx[:, None, None], frs_safe]
+        use = usage[:C][cidx[:, None, None], frs_safe]
+        sq = subtree[:C][cidx[:, None, None], frs_safe]
+
+        relevant = covered & needed
+        fit_r = req[:, None, :] <= av
+        nofit_r = req[:, None, :] > pot
+        preempt_capable_r = ((req[:, None, :] <= nom)
+                             | cq_can_preempt_borrow[:, None, None])
+        res_nofit = relevant & (nofit_r | (~fit_r & ~preempt_capable_r))
+        fit_s = (jnp.all(jnp.where(relevant, fit_r, True), axis=2)
+                 & ~missing & slot_valid)                      # [C,S]
+        nofit_s = jnp.any(res_nofit, axis=2) | missing | ~slot_valid
+        preempt_s = ~fit_s & ~nofit_s
+        has_fit = jnp.any(fit_s, axis=1) & has_head
+        fit_idx = jnp.argmax(fit_s, axis=1).astype(jnp.int32)
+        fit_slot = jnp.where(has_fit, fit_idx, -1)
+        borrow_r = jnp.where(relevant, use + req[:, None, :] > sq, False)
+        borrows_s = jnp.any(borrow_r, axis=2) & has_parent_cq[:, None]
+        borrows = borrows_s[cidx, fit_idx] & has_fit
+        has_preempt = ~has_fit & jnp.any(preempt_s, axis=1) & has_head
+
+        dirty_c = has_head & (has_preempt | ~vec_ok[cidx, row]
+                              | resume[cidx, row])
+        dirty = jnp.any(dirty_c)
+
+        # -- cycle order + forest schedule ----------------------------
+        # entryOrdering (scheduler.go:567) within each forest: borrows
+        # asc then the static (priority desc, ts asc, position) rank.
+        # Forest membership is static, so the schedule is a tiny per-row
+        # argsort over the members matrix — no global sort per cycle.
+        head_crank = wl_cycle_rank[cidx, row]
+        fit_key = jnp.where(
+            has_fit,
+            head_crank + jnp.where(borrows, _BORROW_BIT, 0),
+            INF_I32)                                           # [C]
+        mem_safe = jnp.maximum(members, 0)
+        keys_gl = jnp.where(members >= 0, fit_key[mem_safe],
+                            INF_I32)                           # [G, L]
+        ord_gl = jnp.argsort(keys_gl, axis=1)
+        keys_sorted = jnp.take_along_axis(keys_gl, ord_gl, axis=1)
+        mat = jnp.where(keys_sorted < INF_I32,
+                        jnp.take_along_axis(mem_safe, ord_gl, axis=1),
+                        -1)                                    # [G, L]
+
+        # -- admit scan: one fit head per forest per step -------------
+        def step(u_pair, col):
+            usage, u_cq = u_pair
+            cqs = mat[:, col]                                  # [G]
+
+            def lane(cq):
+                cq_s = jnp.maximum(cq, 0)
+                slot = jnp.maximum(fit_slot[cq_s], 0)
+                frs_l = slot_fr[cq_s, slot]                    # [R]
+                amt_l = req[cq_s]                              # [R]
+                frs_ls = jnp.maximum(frs_l, 0)
+                rel_l = (frs_l >= 0) & (amt_l > 0)
+                avail_row = available_at(usage, subtree, guaranteed,
+                                         borrow_cap, has_blim, parent,
+                                         cq_s, depth)          # [F]
+                ok = jnp.all(jnp.where(rel_l, amt_l <= avail_row[frs_ls],
+                                       True))
+                admit = (cq >= 0) & (fit_slot[cq_s] >= 0) & ok
+                delta = jnp.zeros(F, dtype=jnp.int32).at[frs_ls].add(
+                    jnp.where(rel_l & admit, amt_l, 0))
+                return admit, jnp.where(admit, cq, -1), delta
+
+            admit_l, nodes, deltas = jax.vmap(lane)(cqs)
+            usage = add_usage_chain_batched(usage, nodes, deltas,
+                                            guaranteed, parent, depth)
+            nodes_s = jnp.maximum(nodes, 0)
+            u_cq = u_cq.at[nodes_s].add(
+                jnp.where((nodes >= 0)[:, None], deltas, 0))
+            return (usage, u_cq), admit_l
+
+        u_cq_before = u_cq
+        (usage, u_cq), admit_cols = jax.lax.scan(
+            step, (usage, u_cq), jnp.arange(L))
+        # scatter scan lanes back to per-CQ admitted flags
+        flat_cq = mat.T.reshape(-1)                            # [L*(G+1)]
+        flat_ok = admit_cols.reshape(-1)
+        admitted_c = jnp.zeros(C, dtype=bool).at[
+            jnp.maximum(flat_cq, 0)].max(flat_ok & (flat_cq >= 0))
+
+        # -- requeue semantics ---------------------------------------
+        skipped = has_fit & ~admitted_c            # stays eligible
+        park_new = has_head & ~has_fit & ~dirty_c & ~strict_cq
+        gone = admitted_c | park_new
+        elig = elig.at[cidx, row].set(
+            jnp.where(gone, False, elig[cidx, row]))
+        parked = parked.at[cidx, row].set(
+            park_new | parked[cidx, row])
+        # fungibility resume: a skipped fit head that did not try the
+        # whole flavor list restarts mid-walk next time → dirty then
+        resume = resume.at[cidx, row].set(
+            resume[cidx, row] | (skipped & (fit_slot >= 0)
+                                 & (fit_slot < S - 1)))
+
+        # -- releases at end of cycle --------------------------------
+        delta_cycle = u_cq - u_cq_before                       # [C,F]
+        if runtime > 0:
+            rel = rel.at[(k + runtime) % RTP].add(delta_cycle)
+            release = rel[k % RTP] + ext_release[k]
+            rel = rel.at[k % RTP].set(0)
+        else:
+            release = ext_release[k]
+        u_cq = u_cq - release
+        released_forest = jnp.zeros(G, dtype=bool).at[forest_of_cq].max(
+            jnp.any(release > 0, axis=1))
+        unpark_f = ext_unpark[k] | released_forest             # [G]
+        do_unpark = unpark_f[forest_of_cq]                     # [C]
+        back = parked & do_unpark[:, None]
+        elig = elig | back
+        parked = parked & ~back
+
+        out = (jnp.where(has_head, row, -1), admitted_c, fit_slot,
+               borrows, park_new, dirty)
+        return (elig, parked, resume, u_cq, rel), out
+
+    rel0 = jnp.zeros((RTP, C, F), dtype=jnp.int32)
+    carry0 = (elig0, parked0, resume0, u_cq0, rel0)
+    (elig, parked, resume, u_cq, _), outs = jax.lax.scan(
+        cycle, carry0, jnp.arange(K, dtype=jnp.int32))
+    head_row, admitted, fit_slot, borrows, park_new, dirty = outs
+    return head_row, admitted, fit_slot, borrows, park_new, dirty, u_cq
+
+
+def build_members(forest_of_cq: np.ndarray, n_forests: int,
+                  max_per_forest: int) -> np.ndarray:
+    """Static [G, L] matrix of CQ indices per forest (-1 pad)."""
+    members = np.full((n_forests, max_per_forest), -1, dtype=np.int32)
+    fill = np.zeros(n_forests, dtype=np.int64)
+    for ci, f in enumerate(forest_of_cq):
+        f = int(f)
+        if fill[f] < max_per_forest:
+            members[f, fill[f]] = ci
+            fill[f] += 1
+    return members
+
+
+# ----------------------------------------------------------------------
+# Roofline probe (synthetic; used by scripts/accel_roofline.py)
+# ----------------------------------------------------------------------
+
+_probe_cache: dict = {}
+
+
+def burst_probe(C: int, M: int, R: int, K: int, runtime: int = 4):
+    """One fused-burst dispatch on synthetic north-star-shaped data.
+    Returns the device arrays (caller device_gets them)."""
+    key = (C, M, R)
+    if key not in _probe_cache:
+        rng = np.random.default_rng(0)
+        G = max(1, C // 5)
+        N = C + G
+        F = R
+        parent = np.concatenate([
+            C + (np.arange(C) % G), np.full(G, -1)]).astype(np.int32)
+        node_level = np.concatenate([
+            np.ones(C, np.int32), np.zeros(G, np.int32)])
+        forest_of_cq = (np.arange(C) % G).astype(np.int32)
+        subtree = np.full((N, F), 10**7, np.int32)
+        guaranteed = np.full((N, F), 20_000, np.int32)
+        guaranteed[C:] = 10**7
+        borrow_cap = np.full((N, F), 2**25, np.int32)
+        has_blim = np.zeros((N, F), bool)
+        nominal_cq = np.full((C, F), 20_000, np.int32)
+        slot_fr = np.tile(np.arange(R, dtype=np.int32), (C, 1, 1))
+        slot_valid = np.ones((C, 1), bool)
+        cpb = np.zeros(C, bool)
+        strict = np.zeros(C, bool)
+        members = build_members(forest_of_cq, G, 8)
+        wl_req = rng.integers(200, 2000, (C, M, R)).astype(np.int32)
+        wl_rank = np.argsort(rng.random((C, M))).astype(np.int32)
+        wl_cycle_rank = rng.permutation(C * M).reshape(C, M).astype(np.int32)
+        ones = np.ones((C, M), bool)
+        zeros = np.zeros((C, M), bool)
+        u_cq0 = np.zeros((C, F), np.int32)
+        from .cycle import available_all_np
+        potential0 = available_all_np(
+            np.zeros((N, F), np.int64), subtree, guaranteed, borrow_cap,
+            has_blim, parent, 2).astype(np.int32)
+        _probe_cache[key] = dict(
+            wl_req=wl_req, wl_rank=wl_rank, wl_cycle_rank=wl_cycle_rank,
+            vec_ok=ones, elig0=ones, parked0=zeros, resume0=zeros,
+            u_cq0=u_cq0, potential0=potential0, subtree=subtree,
+            guaranteed=guaranteed, borrow_cap=borrow_cap,
+            has_blim=has_blim, parent=parent, node_level=node_level,
+            nominal_cq=nominal_cq, slot_fr=slot_fr,
+            slot_valid=slot_valid, cq_can_preempt_borrow=cpb,
+            forest_of_cq=forest_of_cq, strict_cq=strict, members=members,
+            G=G)
+    d = _probe_cache[key]
+    G = d["G"]
+    ext_release = np.zeros((K, C, R), np.int32)
+    ext_unpark = np.zeros((K, G), bool)
+    return burst_cycles(
+        d["wl_req"], d["wl_rank"], d["wl_cycle_rank"], d["vec_ok"],
+        d["elig0"], d["parked0"], d["resume0"], d["u_cq0"],
+        d["potential0"], d["subtree"], d["guaranteed"], d["borrow_cap"],
+        d["has_blim"], d["parent"], d["node_level"], d["nominal_cq"],
+        d["slot_fr"], d["slot_valid"],
+        d["cq_can_preempt_borrow"], d["forest_of_cq"], d["strict_cq"],
+        d["members"], ext_release, ext_unpark,
+        K=K, depth=2, L=8, S=1, RTP=runtime + 1, n_levels=2, G=G,
+        runtime=runtime)
+
+
+# ----------------------------------------------------------------------
+# Host side: pack the live queue/cache state into a burst plan
+# ----------------------------------------------------------------------
+
+@dataclass
+class BurstPlan:
+    """Dense device state for one burst + the host maps to apply it."""
+    structure: object                 # PackedStructure
+    arrays: dict                      # kernel inputs (numpy)
+    keys: list                        # [C][M] workload key or None
+    C: int
+    M: int
+    L: int
+    G: int
+    n_levels: int
+
+
+def _queue_order_key(ordering, info):
+    """(priority desc, queue-order timestamp asc, key asc) sort tuple —
+    cluster_queue.go:408 queueOrderingFunc."""
+    return (-info.obj.priority, ordering.queue_order_timestamp(info.obj),
+            info.key)
+
+
+def pack_burst(structure, queues, cache, scheduler, clock) -> Optional[BurstPlan]:
+    """Build the dense [C, M] state from the live queues + cache.
+
+    Returns None when the cluster can't be burst-scheduled at all
+    (inexact usage scaling, unknown flavor-resources).  Per-workload
+    limitations never fail the pack — they mark the row ``vec_ok=False``
+    so the cycle that would schedule the row goes dirty and runs on the
+    normal host path instead."""
+    st = structure
+    C = len(st.cq_names)
+    F = max(1, len(st.fr_index))
+    R = len(st.resource_names)
+    S = st.slot_fr.shape[1]
+    ordering = scheduler.ordering
+
+    # CQ-position order (the queue manager's heads enumeration order)
+    cq_pos = {name: i for i, name in
+              enumerate(queues.cluster_queue_names())}
+
+    members_by_ci: list[list] = [[] for _ in range(C)]
+    parked_by_ci: list[set] = [set() for _ in range(C)]
+    strict = np.zeros(C, dtype=bool)
+    from ..api.types import QueueingStrategy
+    for name in queues.cluster_queue_names():
+        ci = st.cq_index.get(name)
+        q = queues.queue_for(name)
+        if ci is None:
+            if q is not None and q.active and q.pending_active():
+                return None   # an active CQ the structure doesn't know
+            continue
+        if q is None or not q.active:
+            continue
+        strict[ci] = q.queueing_strategy == QueueingStrategy.STRICT_FIFO
+        for info in q.heap.items():
+            members_by_ci[ci].append(info)
+        for key, info in q.inadmissible.items():
+            rs = info.obj.requeue_state
+            if rs is not None and rs.requeue_at is not None:
+                # backoff-parked: excluded; a mid-burst expiry diverges
+                # the heads and the application validator truncates
+                continue
+            members_by_ci[ci].append(info)
+            parked_by_ci[ci].add(info.key)
+
+    n_members = sum(len(m) for m in members_by_ci)
+    if n_members == 0:
+        return None
+    from .packing import _bucket
+    M = _bucket(max(len(m) for m in members_by_ci), minimum=4)
+
+    wl_req = np.zeros((C, M, R), dtype=np.int32)
+    wl_rank = np.full((C, M), INF_I32, dtype=np.int32)
+    wl_cycle_rank = np.zeros((C, M), dtype=np.int32)
+    vec_ok = np.zeros((C, M), dtype=bool)
+    elig = np.zeros((C, M), dtype=bool)
+    parked = np.zeros((C, M), dtype=bool)
+    resume = np.zeros((C, M), dtype=bool)
+    keys: list[list] = [[None] * M for _ in range(C)]
+
+    scale = st.resource_scale
+    scale_is_one = st.scale_is_one
+    cq_ok = st.cq_vector_ok if st.cq_vector_ok is not None else np.zeros(C, bool)
+    assumed = cache.assumed_workloads
+    from ..api.types import AdmissionCheckState
+
+    # global cycle-order rank: (priority desc, ts asc, CQ heads-position)
+    flat = []
+    for ci in range(C):
+        members_by_ci[ci].sort(key=lambda i: _queue_order_key(ordering, i))
+        pos = cq_pos.get(st.cq_names[ci], C)
+        for info in members_by_ci[ci]:
+            flat.append((-info.obj.priority,
+                         ordering.queue_order_timestamp(info.obj), pos,
+                         ci, info))
+    flat.sort(key=lambda t: t[:3])
+    crank_of = {t[4].key: i for i, t in enumerate(flat)}
+
+    for ci in range(C):
+        cq_name = st.cq_names[ci]
+        cq_live = cache.cluster_queue(cq_name)
+        covers_pods = cq_name in st.cq_covers_pods
+        for mi, info in enumerate(members_by_ci[ci]):
+            key = info.key
+            keys[ci][mi] = key
+            wl_rank[ci, mi] = mi
+            wl_cycle_rank[ci, mi] = crank_of[key]
+            if key in parked_by_ci[ci]:
+                parked[ci, mi] = True
+            else:
+                elig[ci, mi] = True
+            ok = bool(cq_ok[ci])
+            obj = info.obj
+            if ok and (len(obj.pod_sets) != 1
+                       or obj.pod_sets[0].topology_request is not None
+                       or any(ps.min_count is not None
+                              and ps.min_count < ps.count
+                              for ps in obj.pod_sets)):
+                ok = False
+            if ok and (key in assumed or obj.is_admitted):
+                ok = False
+            if ok and any(stt.state in (AdmissionCheckState.RETRY,
+                                        AdmissionCheckState.REJECTED)
+                          for stt in obj.admission_check_states.values()):
+                ok = False
+            if ok and cq_live is not None and cq_live.spec.namespace_selector:
+                ok = False    # selector evaluation stays on the host path
+            if ok and scheduler.limit_range_summaries.get(obj.namespace):
+                ok = False
+            # requests -> scaled [R]
+            exact = True
+            acc = np.zeros(R, dtype=np.int64)
+            for psr in info.total_requests:
+                for r, v in psr.requests.items():
+                    if r == "pods" and not covers_pods:
+                        continue
+                    ri = st.r_index.get(r)
+                    if ri is None:
+                        exact = False
+                        continue
+                    if v < 0:
+                        exact = False
+                        v = 0
+                    if scale_is_one:
+                        acc[ri] += int(v)
+                    else:
+                        s = int(scale[ri])
+                        q_, rem = divmod(int(v), s)
+                        if rem:
+                            exact = False
+                            q_ += 1
+                        acc[ri] += q_
+            if acc.max(initial=0) > I32_MAX:
+                exact = False
+                np.clip(acc, None, I32_MAX, out=acc)
+            wl_req[ci, mi] = acc.astype(np.int32)
+            if not exact:
+                ok = False
+            last = info.last_assignment
+            if last is not None and getattr(last, "pending_flavors", False):
+                if (cq_live is not None and last.cluster_queue_generation
+                        >= cq_live.allocatable_generation):
+                    resume[ci, mi] = True
+            vec_ok[ci, mi] = ok
+
+    # CQ-level usage, scaled exactly (else no burst)
+    u_cq = np.zeros((C, F), dtype=np.int32)
+    for ci, name in enumerate(st.cq_names):
+        cq_live = cache.cluster_queue(name)
+        if cq_live is None:
+            return None
+        for fr, v in cq_live.resource_node.usage.items():
+            fi = st.fr_index.get(fr)
+            if fi is None:
+                return None
+            if scale_is_one:
+                q_ = int(v)
+            else:
+                s = int(scale[st.r_index[fr.resource]])
+                q_, rem = divmod(int(v), s)
+                if rem:
+                    return None
+            if q_ > I32_MAX:
+                return None
+            u_cq[ci, fi] = q_
+
+    # tree metadata
+    parent = st.parent
+    N = st.node_count
+    node_level = np.zeros(N, dtype=np.int32)
+    for ni in range(N):
+        lvl, p = 0, parent[ni]
+        while p >= 0:
+            lvl += 1
+            p = parent[p]
+        node_level[ni] = lvl
+    # node_level[ni] = distance from root (roots = 0); rebuild_usage
+    # sweeps deepest levels first via range(n_levels-1, 0, -1)
+    n_levels = int(node_level.max()) + 1
+    G = st.n_forests
+    forest_of_cq = st.forest_of_node[:C].astype(np.int32)
+    per_forest = np.bincount(forest_of_cq, minlength=G)
+    L = max(1, int(per_forest.max()))
+    members = build_members(forest_of_cq, G, L)
+
+    from .cycle import available_all_np
+    potential0 = np.minimum(available_all_np(
+        np.zeros((N, F), np.int64), st.subtree_quota, st.guaranteed,
+        st.borrow_cap, st.has_borrow_limit, st.parent, st.depth),
+        np.int64(I32_MAX)).astype(np.int32)
+
+    arrays = dict(
+        wl_req=wl_req, wl_rank=wl_rank, wl_cycle_rank=wl_cycle_rank,
+        vec_ok=vec_ok, elig0=elig, parked0=parked, resume0=resume,
+        u_cq0=u_cq, potential0=potential0,
+        subtree=st.subtree_quota, guaranteed=st.guaranteed,
+        borrow_cap=st.borrow_cap, has_blim=st.has_borrow_limit,
+        parent=st.parent, node_level=node_level,
+        nominal_cq=st.nominal_cq,
+        slot_fr=st.slot_fr, slot_valid=st.slot_valid,
+        cq_can_preempt_borrow=st.cq_can_preempt_borrow,
+        forest_of_cq=forest_of_cq, strict_cq=strict, members=members)
+    return BurstPlan(structure=st, arrays=arrays, keys=keys,
+                     C=C, M=M, L=L, G=G, n_levels=n_levels)
+
+
+K_BURST_LADDER = (8, 32, 64)
+
+
+class BurstSolver:
+    """Dispatch fused bursts and expose the decisions for application.
+
+    ``backend``: "cpu" | "accel" | "auto" (auto = cpu; the roofline
+    measurement ROOFLINE_r04.json shows XLA-CPU wins the fused kernel at
+    every shape in this environment — the accel's incremental per-cycle
+    compute matches the CPU's but each dispatch adds the tunnel RTT)."""
+
+    def __init__(self, backend: str = "auto"):
+        self.backend = backend
+        self.stats = {"burst_dispatches": 0, "burst_cycles_decided": 0,
+                      "burst_accel_dispatches": 0,
+                      "burst_dispatch_s": 0.0}
+
+    def _device(self):
+        import jax
+        try:
+            if self.backend == "accel":
+                default = jax.devices()[0]
+                if default.platform != "cpu":
+                    return default
+            return jax.devices("cpu")[0]
+        except RuntimeError:
+            # a registered accelerator plugin that can't initialize must
+            # not take the CPU path down with it (solver.py discipline)
+            try:
+                jax.config.update("jax_platforms", "cpu")
+            except Exception:
+                pass
+            return jax.devices("cpu")[0]
+
+    def run(self, plan: BurstPlan, K: int, runtime: int,
+            ext_release: np.ndarray, ext_unpark: np.ndarray):
+        """One fused dispatch of K cycles.  Returns numpy decision arrays
+        (head_row, admitted, fit_slot, borrows, park_new, dirty)."""
+        import jax
+        import time as _time
+        st = plan.structure
+        dev = self._device()
+        a = plan.arrays
+        t0 = _time.perf_counter()
+        with jax.default_device(dev):
+            out = burst_cycles(
+                a["wl_req"], a["wl_rank"], a["wl_cycle_rank"], a["vec_ok"],
+                a["elig0"], a["parked0"], a["resume0"], a["u_cq0"],
+                a["potential0"], a["subtree"], a["guaranteed"],
+                a["borrow_cap"], a["has_blim"], a["parent"],
+                a["node_level"], a["nominal_cq"],
+                a["slot_fr"], a["slot_valid"], a["cq_can_preempt_borrow"],
+                a["forest_of_cq"], a["strict_cq"], a["members"],
+                ext_release, ext_unpark,
+                K=K, depth=st.depth, L=plan.L,
+                S=int(st.slot_fr.shape[1]), RTP=max(1, runtime + 1),
+                n_levels=plan.n_levels, G=plan.G, runtime=max(0, runtime))
+            out = jax.device_get(out)
+        self.stats["burst_dispatches"] += 1
+        self.stats["burst_cycles_decided"] += K
+        self.stats["burst_dispatch_s"] += _time.perf_counter() - t0
+        if dev.platform != "cpu":
+            self.stats["burst_accel_dispatches"] += 1
+        return out

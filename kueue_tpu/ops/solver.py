@@ -866,7 +866,8 @@ class CycleSolver:
 
     # -- assignment reconstruction -------------------------------------
 
-    def build_fit_assignment(self, cls: ClassifiedCycle, wi: int) -> Assignment:
+    def build_fit_assignment(self, cls: ClassifiedCycle,
+                             wi) -> Assignment:
         """Host Assignment for a device-classified Fit head, including the
         fungibility resume state the host walk would record."""
         slot = int(cls.fit_slot0[wi])
@@ -877,42 +878,9 @@ class CycleSolver:
                           mode: Mode, borrow: bool,
                           res_modes: Optional[dict] = None) -> Assignment:
         h = cls.heads[wi]
-        snapshot = cls.snapshot
-        cq = snapshot.cq(h.cluster_queue)
-        rg = cq.spec.resource_groups[0]
-        covers_pods = "pods" in rg.covered_resources
-        flavor_name = rg.flavors[slot].name
-        n_slots = len(rg.flavors)
-        tried = -1 if slot == n_slots - 1 else slot
-
-        assignment = Assignment()
-        assignment.borrowing = borrow
-        assignment.last_state = AssignmentClusterQueueState(
-            cluster_queue_generation=cq.allocatable_generation)
-        for psr in h.total_requests:
-            # mirror the host's implicit "pods" handling
-            # (flavorassigner.go:226 / _assign_flavors)
-            reqs = dict(psr.requests)
-            if covers_pods:
-                reqs["pods"] = psr.count
-            else:
-                reqs.pop("pods", None)
-            ps_res = PodSetAssignmentResult(
-                name=psr.name, requests=Requests(reqs), count=psr.count)
-            flavor_idx: dict[str, int] = {}
-            for res in reqs:
-                res_mode = mode if res_modes is None else res_modes.get(
-                    res, mode)
-                ps_res.flavors[res] = FlavorAssignmentDecision(
-                    name=flavor_name, mode=res_mode, borrow=borrow,
-                    tried_flavor_idx=tried)
-                flavor_idx[res] = tried
-                fr = FlavorResource(flavor_name, res)
-                assignment.usage[fr] = (assignment.usage.get(fr, 0)
-                                        + reqs[res])
-            assignment.pod_sets.append(ps_res)
-            assignment.last_state.last_tried_flavor_idx.append(flavor_idx)
-        return assignment
+        cq = cls.snapshot.cq(h.cluster_queue)
+        return build_slot_assignment(h, cq, slot, mode, borrow,
+                                     res_modes=res_modes)
 
     def build_preempt_assignment(self, cls: ClassifiedCycle,
                                  wi: int) -> Assignment:
@@ -972,3 +940,48 @@ class CycleSolver:
             if cls.fit_slot0[wi] >= 0:
                 out[cls.heads[wi].key] = self.build_fit_assignment(cls, wi)
         return out
+
+
+def build_slot_assignment(info: Info, cq, slot: int, mode: Mode,
+                          borrow: bool,
+                          res_modes: Optional[dict] = None) -> Assignment:
+    """Reconstruct the host Assignment a device-classified head would get
+    from the flavor walk: single resource group, slot = flavor index,
+    including the fungibility resume state (flavorassigner.go:499 under
+    default fungibility).  ``cq`` is any CQState (snapshot or live cache)
+    carrying .spec and .allocatable_generation."""
+    slot = int(slot)
+    rg = cq.spec.resource_groups[0]
+    covers_pods = "pods" in rg.covered_resources
+    flavor_name = rg.flavors[slot].name
+    n_slots = len(rg.flavors)
+    tried = -1 if slot == n_slots - 1 else slot
+
+    assignment = Assignment()
+    assignment.borrowing = borrow
+    assignment.last_state = AssignmentClusterQueueState(
+        cluster_queue_generation=cq.allocatable_generation)
+    for psr in info.total_requests:
+        # mirror the host's implicit "pods" handling
+        # (flavorassigner.go:226 / _assign_flavors)
+        reqs = dict(psr.requests)
+        if covers_pods:
+            reqs["pods"] = psr.count
+        else:
+            reqs.pop("pods", None)
+        ps_res = PodSetAssignmentResult(
+            name=psr.name, requests=Requests(reqs), count=psr.count)
+        flavor_idx: dict[str, int] = {}
+        for res in reqs:
+            res_mode = mode if res_modes is None else res_modes.get(
+                res, mode)
+            ps_res.flavors[res] = FlavorAssignmentDecision(
+                name=flavor_name, mode=res_mode, borrow=borrow,
+                tried_flavor_idx=tried)
+            flavor_idx[res] = tried
+            fr = FlavorResource(flavor_name, res)
+            assignment.usage[fr] = (assignment.usage.get(fr, 0)
+                                    + reqs[res])
+        assignment.pod_sets.append(ps_res)
+        assignment.last_state.last_tried_flavor_idx.append(flavor_idx)
+    return assignment

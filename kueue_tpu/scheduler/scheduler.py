@@ -559,6 +559,64 @@ class Scheduler:
                 self._set_skipped(e, "Workload no longer fits after "
                                      "processing another workload")
 
+    # ------------------------------------------------------------------
+    # Burst application — fused multi-cycle decisions (ops/burst.py)
+    # ------------------------------------------------------------------
+
+    def apply_burst_cycle(self, heads: list[Info],
+                          modeled: dict) -> CycleStats:
+        """Apply one fused-burst cycle's decisions to the real state.
+
+        ``modeled``: {workload key: ("admit"|"skip"|"park", slot,
+        borrows)} from the burst kernel.  The caller has already
+        validated that ``heads`` matches the modeled head set exactly;
+        this applies the same mutations the normal admit loop would —
+        assume + apply for admissions, skip/park requeues — without
+        re-deciding anything (reference scheduler.go:211-284 with the
+        decisions precomputed)."""
+        from ..ops.solver import build_slot_assignment
+        self.scheduling_cycle += 1
+        stats = CycleStats(cycle=self.scheduling_cycle)
+        start = self.clock()
+        for info in heads:
+            lq = self.queues.local_queues.get(
+                f"{info.obj.namespace}/{info.obj.queue_name}")
+            info.cluster_queue = lq.cluster_queue if lq else ""
+            e = Entry(info=info)
+            kind, slot, borrows = modeled[info.key]
+            cq = self.cache.cluster_queue(info.cluster_queue)
+            if kind == "admit":
+                e.assignment = build_slot_assignment(
+                    info, cq, slot, Mode.FIT, borrows)
+                e.info.last_assignment = e.assignment.last_state
+                e.status = EntryStatus.NOMINATED
+                if self._admit(e, cq):
+                    stats.admitted.append(info.key)
+                    continue
+                # mirror the normal path's failure handling
+                # (scheduler.go:490): _admit already requeued an ASSUMED
+                # entry whose async apply failed
+                e.inadmissible_msg = "Failed to admit workload"
+                if e.status != EntryStatus.ASSUMED:
+                    stats.inadmissible.append(info.key)
+                    self._requeue_and_update(e)
+                continue
+            if kind == "skip":
+                e.assignment = build_slot_assignment(
+                    info, cq, slot, Mode.FIT, borrows)
+                e.info.last_assignment = e.assignment.last_state
+                self._set_skipped(e, "Workload no longer fits after "
+                                     "processing another workload")
+                stats.skipped.append(info.key)
+            else:  # park: NoFit at nominate (BestEffortFIFO parks it)
+                e.info.last_assignment = None
+                e.inadmissible_msg = ("couldn't assign flavors to pod "
+                                      "set: insufficient quota")
+                stats.inadmissible.append(info.key)
+            self._requeue_and_update(e)
+        stats.duration_s = self.clock() - start
+        return stats
+
     @staticmethod
     def _has_retry_or_rejected_checks(wl: Workload) -> bool:
         return any(st.state in (AdmissionCheckState.RETRY, AdmissionCheckState.REJECTED)
