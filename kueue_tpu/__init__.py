@@ -9,3 +9,13 @@ that mirrors the reference's cache/queue/event semantics.
 """
 
 __version__ = "0.1.0"
+
+# Loading XLA:CPU AOT compilation-cache entries logs two multi-KB ERROR
+# lines about tuning pseudo-features per load; the env var must be set
+# before jaxlib's static initialization, so it lives here rather than in
+# compilecache.enable().  KUEUE_TPU_COMPILE_CACHE=0 restores full logs.
+import os as _os
+
+if _os.environ.get("KUEUE_TPU_COMPILE_CACHE") != "0":
+    _os.environ.setdefault("TF_CPP_MIN_LOG_LEVEL", "3")
+del _os

@@ -105,6 +105,7 @@ class Driver:
         self.metrics = metrics.Registry()
         self.scheduler.metrics = self.metrics
         self._burst_solver = None   # lazy BurstSolver (ops/burst.py)
+        self._burst_m = 0           # sticky M bucket across burst packs
 
     @classmethod
     def from_config(cls, cfg, clock: Callable[[], float] = time.time,
@@ -630,17 +631,36 @@ class Driver:
         solver = self.scheduler.solver
         normal_streak = 0   # cycles to run normally before re-bursting
 
+        from ..api.types import WL_QUOTA_RESERVED
+
+        def _reservation_ts(key):
+            wl = self.workloads.get(key)
+            if wl is None or not wl.has_quota_reservation:
+                return None
+            c = wl.conditions.get(WL_QUOTA_RESERVED)
+            return c.last_transition_time if c is not None else None
+
+        # a finish obligation is bound to the ADMISSION that scheduled
+        # it: a workload preempted and re-admitted in between must get a
+        # full new run, not a truncated one (the host harness prunes
+        # stale entries the moment the reservation drops)
+        sched_ts: dict = {key: _reservation_ts(key)
+                          for keys in ext.values() for key in keys}
+
         def finish_cycle(stats) -> None:
             """Record one applied cycle + its end-of-cycle finishes."""
             k = len(out)
             out.append(stats)
-            for key in ext.pop(k, []):
-                self.finish_workload(key)
+            for key in stats.admitted:
+                sched_ts[key] = _reservation_ts(key)
+            due = list(ext.pop(k, []))
             if runtime > 0 and k - runtime >= 0:
-                for key in out[k - runtime].admitted:
-                    wl = self.workloads.get(key)
-                    if wl is not None and wl.has_quota_reservation:
-                        self.finish_workload(key)
+                due.extend(out[k - runtime].admitted)
+            for key in due:
+                wl = self.workloads.get(key)
+                if (wl is not None and wl.has_quota_reservation
+                        and _reservation_ts(key) == sched_ts.get(key)):
+                    self.finish_workload(key)
             if on_cycle is not None:
                 on_cycle(k, stats)
 
@@ -690,11 +710,13 @@ class Driver:
             snapshot = self.cache.snapshot()
             st = solver._structure_for(snapshot, [])
             plan = pack_burst(st, self.queues, self.cache,
-                              self.scheduler, self.clock)
+                              self.scheduler, self.clock,
+                              min_m=self._burst_m)
             if plan is None:
                 if not normal_cycle() and quiescent():
                     break
                 continue
+            self._burst_m = max(self._burst_m, plan.M)
             remaining = max_cycles - len(out)
             K = next((r for r in K_BURST_LADDER if r >= min(
                 remaining, K_BURST_LADDER[-1])), K_BURST_LADDER[-1])
@@ -710,8 +732,8 @@ class Driver:
                 for j in range(max(0, len(out) - runtime), len(out)):
                     due = j + runtime
                     keys = [key for key in out[j].admitted
-                            if (wl := self.workloads.get(key)) is not None
-                            and wl.has_quota_reservation]
+                            if _reservation_ts(key) is not None
+                            and _reservation_ts(key) == sched_ts.get(key)]
                     if keys:
                         sched.setdefault(due, []).extend(keys)
             if not self._fill_ext_release(st, plan, sched, len(out), K,

@@ -383,7 +383,8 @@ def _queue_order_key(ordering, info):
             info.key)
 
 
-def pack_burst(structure, queues, cache, scheduler, clock) -> Optional[BurstPlan]:
+def pack_burst(structure, queues, cache, scheduler, clock,
+               min_m: int = 0) -> Optional[BurstPlan]:
     """Build the dense [C, M] state from the live queues + cache.
 
     Returns None when the cluster can't be burst-scheduled at all
@@ -431,7 +432,10 @@ def pack_burst(structure, queues, cache, scheduler, clock) -> Optional[BurstPlan
     if n_members == 0:
         return None
     from .packing import _bucket
-    M = _bucket(max(len(m) for m in members_by_ci), minimum=4)
+    # sticky minimum keeps M stable across re-packs as queues drain
+    # (every distinct M is a fresh XLA compilation)
+    M = max(_bucket(max(len(m) for m in members_by_ci), minimum=4),
+            min_m)
 
     wl_req = np.zeros((C, M, R), dtype=np.int32)
     wl_rank = np.full((C, M), INF_I32, dtype=np.int32)
@@ -600,6 +604,8 @@ class BurstSolver:
     compute matches the CPU's but each dispatch adds the tunnel RTT)."""
 
     def __init__(self, backend: str = "auto"):
+        from ..compilecache import enable as _enable_compile_cache
+        _enable_compile_cache()
         self.backend = backend
         self.stats = {"burst_dispatches": 0, "burst_cycles_decided": 0,
                       "burst_accel_dispatches": 0,

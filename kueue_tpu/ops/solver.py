@@ -140,6 +140,8 @@ class CycleSolver:
     def __init__(self, ordering: Ordering | None = None,
                  backend: str = "auto",
                  accel_min_heads: int | None = None):
+        from ..compilecache import enable as _enable_compile_cache
+        _enable_compile_cache()
         self.ordering = ordering or Ordering()
         if backend == "device":      # legacy alias
             backend = "auto"

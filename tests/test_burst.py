@@ -272,6 +272,40 @@ def test_burst_repack_carries_finish_schedule():
     assert da.admitted_keys() == db.admitted_keys()
 
 
+def test_burst_external_finish_of_preempted_workload_is_skipped():
+    """An external finish schedule built before a preemption must not
+    finish the (now evicted and re-pending) workload — the northstar
+    divergence regression: segment 1 admits W, segment 2's external
+    schedule says W finishes at cycle f, but a preemptor evicts W at
+    cycle e < f.  W must survive, requeue, and re-admit later."""
+    pre = PreemptionPolicy(
+        reclaim_within_cohort=ReclaimWithinCohort.ANY,
+        within_cluster_queue=WithinClusterQueue.LOWER_PRIORITY)
+
+    def spec(d):
+        simple_cluster(n_cohorts=1, cqs=1, nominal=4000,
+                       preemption=pre)(d)
+        d.create_workload(mk("victim", "lq-0-0", 4000, prio=0, t=1.0))
+
+    db, cb = build(spec)
+    cb.t += 1.0
+    db.schedule_once()          # victim admitted
+    db.create_workload(mk("boss", "lq-0-0", 4000, prio=100, t=50.0))
+    # external schedule claims victim finishes at offset 2, but the boss
+    # preempts it at cycle 0 — the admission-identity guard must skip
+    # the stale finish
+    ext = {2: ["default/victim"]}
+    stats = db.schedule_burst(8, runtime=3, external_finishes=ext,
+                              on_cycle_start=lambda k: setattr(
+                                  cb, "t", cb.t + 1.0))
+    wl = db.workloads["default/victim"]
+    assert not wl.is_finished, \
+        "external finish must not apply to an evicted workload"
+    assert any("default/victim" in s.preempted_targets for s in stats)
+    # victim re-admits after boss's modeled runtime elapses
+    assert any("default/victim" in s.admitted for s in stats)
+
+
 def test_burst_multi_flavor_and_resume_dirty():
     """Multi-flavor CQs: fit-slot selection matches; skipped heads with
     untried flavors force dirty cycles (resume state is host-only)."""
