@@ -376,11 +376,43 @@ class BurstPlan:
     n_levels: int
 
 
-def _queue_order_key(ordering, info):
-    """(priority desc, queue-order timestamp asc, key asc) sort tuple —
-    cluster_queue.go:408 queueOrderingFunc."""
-    return (-info.obj.priority, ordering.queue_order_timestamp(info.obj),
-            info.key)
+def _static_row(info, st, covers_pods: bool):
+    """Per-Info static pack facts: (covers_pods, scaled request vector,
+    static vectorized-eligibility).  Cached on the Info keyed by the
+    structure generation — total_requests are immutable per Info."""
+    R = len(st.resource_names)
+    scale = st.resource_scale
+    obj = info.obj
+    ok = (len(obj.pod_sets) == 1
+          and obj.pod_sets[0].topology_request is None
+          and not any(ps.min_count is not None and ps.min_count < ps.count
+                      for ps in obj.pod_sets))
+    exact = True
+    acc = np.zeros(R, dtype=np.int64)
+    for psr in info.total_requests:
+        for r, v in psr.requests.items():
+            if r == "pods" and not covers_pods:
+                continue
+            ri = st.r_index.get(r)
+            if ri is None:
+                exact = False
+                continue
+            if v < 0:
+                exact = False
+                v = 0
+            if st.scale_is_one:
+                acc[ri] += int(v)
+            else:
+                s = int(scale[ri])
+                q_, rem = divmod(int(v), s)
+                if rem:
+                    exact = False
+                    q_ += 1
+                acc[ri] += q_
+    if acc.max(initial=0) > I32_MAX:
+        exact = False
+        np.clip(acc, None, I32_MAX, out=acc)
+    return covers_pods, acc.astype(np.int32), ok and exact
 
 
 def pack_burst(structure, queues, cache, scheduler, clock,
@@ -450,86 +482,102 @@ def pack_burst(structure, queues, cache, scheduler, clock,
     scale_is_one = st.scale_is_one
     cq_ok = st.cq_vector_ok if st.cq_vector_ok is not None else np.zeros(C, bool)
     assumed = cache.assumed_workloads
-    from ..api.types import AdmissionCheckState
+    gen = st.generation
 
-    # global cycle-order rank: (priority desc, ts asc, CQ heads-position)
-    flat = []
-    for ci in range(C):
-        members_by_ci[ci].sort(key=lambda i: _queue_order_key(ordering, i))
-        pos = cq_pos.get(st.cq_names[ci], C)
-        for info in members_by_ci[ci]:
-            flat.append((-info.obj.priority,
-                         ordering.queue_order_timestamp(info.obj), pos,
-                         ci, info))
-    flat.sort(key=lambda t: t[:3])
-    crank_of = {t[4].key: i for i, t in enumerate(flat)}
+    # flatten members with one Python pass; static per-workload facts
+    # (scaled request vector, shape eligibility) are cached on the Info
+    # object keyed by structure generation — requests are immutable per
+    # Info instance, so re-packs touch each workload only lightly
+    n = n_members
+    infos_flat: list = [None] * n
+    ci_a = np.empty(n, dtype=np.int32)
+    prio_a = np.empty(n, dtype=np.int64)
+    ts_a = np.empty(n, dtype=np.float64)
+    pos_a = np.empty(n, dtype=np.int32)
+    parked_a = np.zeros(n, dtype=bool)
+    ok_a = np.zeros(n, dtype=bool)
+    resume_a = np.zeros(n, dtype=bool)
+    req_mat = np.zeros((n, R), dtype=np.int32)
+    key_a: list[str] = [""] * n
+    qts = ordering.queue_order_timestamp
 
+    i = 0
     for ci in range(C):
+        mlist = members_by_ci[ci]
+        if not mlist:
+            continue
         cq_name = st.cq_names[ci]
         cq_live = cache.cluster_queue(cq_name)
         covers_pods = cq_name in st.cq_covers_pods
-        for mi, info in enumerate(members_by_ci[ci]):
-            key = info.key
-            keys[ci][mi] = key
-            wl_rank[ci, mi] = mi
-            wl_cycle_rank[ci, mi] = crank_of[key]
-            if key in parked_by_ci[ci]:
-                parked[ci, mi] = True
-            else:
-                elig[ci, mi] = True
-            ok = bool(cq_ok[ci])
+        pos = cq_pos.get(cq_name, C)
+        cq_vec = bool(cq_ok[ci])
+        if cq_vec and cq_live is not None and cq_live.spec.namespace_selector:
+            cq_vec = False   # selector evaluation stays on the host path
+        lr_summaries = scheduler.limit_range_summaries
+        allocatable = (cq_live.allocatable_generation
+                       if cq_live is not None else -1)
+        pk = parked_by_ci[ci]
+        for info in mlist:
             obj = info.obj
-            if ok and (len(obj.pod_sets) != 1
-                       or obj.pod_sets[0].topology_request is not None
-                       or any(ps.min_count is not None
-                              and ps.min_count < ps.count
-                              for ps in obj.pod_sets)):
+            row = getattr(info, "_burst_row", None)
+            if row is None or row[0] != gen or row[1] != covers_pods:
+                row = (gen, *_static_row(info, st, covers_pods))
+                info._burst_row = row
+            _, _, req_vec, static_ok = row
+            key = info.key
+            infos_flat[i] = info
+            key_a[i] = key
+            ci_a[i] = ci
+            prio_a[i] = obj.priority
+            ts_a[i] = qts(obj)
+            pos_a[i] = pos
+            parked_a[i] = key in pk
+            req_mat[i] = req_vec
+            ok = cq_vec and static_ok
+            if ok and lr_summaries and lr_summaries.get(obj.namespace):
+                ok = False   # LimitRange bounds stay on the host path
+            if ok and (key in assumed or obj.admission is not None):
                 ok = False
-            if ok and (key in assumed or obj.is_admitted):
-                ok = False
-            if ok and any(stt.state in (AdmissionCheckState.RETRY,
-                                        AdmissionCheckState.REJECTED)
-                          for stt in obj.admission_check_states.values()):
-                ok = False
-            if ok and cq_live is not None and cq_live.spec.namespace_selector:
-                ok = False    # selector evaluation stays on the host path
-            if ok and scheduler.limit_range_summaries.get(obj.namespace):
-                ok = False
-            # requests -> scaled [R]
-            exact = True
-            acc = np.zeros(R, dtype=np.int64)
-            for psr in info.total_requests:
-                for r, v in psr.requests.items():
-                    if r == "pods" and not covers_pods:
-                        continue
-                    ri = st.r_index.get(r)
-                    if ri is None:
-                        exact = False
-                        continue
-                    if v < 0:
-                        exact = False
-                        v = 0
-                    if scale_is_one:
-                        acc[ri] += int(v)
-                    else:
-                        s = int(scale[ri])
-                        q_, rem = divmod(int(v), s)
-                        if rem:
-                            exact = False
-                            q_ += 1
-                        acc[ri] += q_
-            if acc.max(initial=0) > I32_MAX:
-                exact = False
-                np.clip(acc, None, I32_MAX, out=acc)
-            wl_req[ci, mi] = acc.astype(np.int32)
-            if not exact:
-                ok = False
+            if ok and obj.admission_check_states:
+                from ..api.types import AdmissionCheckState
+                if any(stt.state in (AdmissionCheckState.RETRY,
+                                     AdmissionCheckState.REJECTED)
+                       for stt in obj.admission_check_states.values()):
+                    ok = False
+            ok_a[i] = ok
             last = info.last_assignment
-            if last is not None and getattr(last, "pending_flavors", False):
-                if (cq_live is not None and last.cluster_queue_generation
-                        >= cq_live.allocatable_generation):
-                    resume[ci, mi] = True
-            vec_ok[ci, mi] = ok
+            if (last is not None
+                    and getattr(last, "pending_flavors", False)
+                    and last.cluster_queue_generation >= allocatable):
+                resume_a[i] = True
+            i += 1
+
+    # heap rank within each CQ: one global lexsort replaces C Python
+    # sorts (priority desc, queue-order ts asc, key asc —
+    # cluster_queue.go:408)
+    key_arr = np.asarray(key_a)
+    order = np.lexsort((key_arr, ts_a, -prio_a, ci_a))
+    ci_sorted = ci_a[order]
+    first = np.ones(n, dtype=bool)
+    first[1:] = ci_sorted[1:] != ci_sorted[:-1]
+    seg_start = np.maximum.accumulate(
+        np.where(first, np.arange(n), 0))
+    mi_sorted = (np.arange(n) - seg_start).astype(np.int64)
+    mi_a = np.empty(n, dtype=np.int64)
+    mi_a[order] = mi_sorted
+    # global cycle-order rank (priority desc, ts asc, heads-position)
+    crank = np.empty(n, dtype=np.int64)
+    crank[np.lexsort((pos_a, ts_a, -prio_a))] = np.arange(n)
+
+    wl_rank[ci_a, mi_a] = mi_a
+    wl_cycle_rank[ci_a, mi_a] = crank
+    parked[ci_a, mi_a] = parked_a
+    elig[ci_a, mi_a] = ~parked_a
+    vec_ok[ci_a, mi_a] = ok_a
+    resume[ci_a, mi_a] = resume_a
+    wl_req[ci_a, mi_a] = req_mat
+    for j in range(n):
+        keys[int(ci_a[j])][int(mi_a[j])] = key_a[j]
 
     # CQ-level usage, scaled exactly (else no burst)
     u_cq = np.zeros((C, F), dtype=np.int32)

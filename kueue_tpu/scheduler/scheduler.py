@@ -79,6 +79,7 @@ class CycleStats:
     inadmissible: list[str] = field(default_factory=list)
     preempted_targets: list[str] = field(default_factory=list)
     duration_s: float = 0.0
+    finish_s: float = 0.0     # workload-finish application (burst mode)
 
 
 class Scheduler:
@@ -115,6 +116,12 @@ class Scheduler:
         # vs the scalar per-entry computeDRS (parity oracle).
         self.fs_batched = True
         self._fs_tracker = None
+        # visibility for the batched-tournament fallback (a production
+        # FS workload silently running the O(entries²) scalar oracle was
+        # round-3 weak #8): counts cycles where the tracker couldn't
+        # represent an entry and rounds that used the scalar path
+        self.fs_stats = {"tracker_unavailable_cycles": 0,
+                         "scalar_drs_rounds": 0}
         # WaitForPodsReady blockAdmission gate (reference scheduler.go
         # :268-279): True → hold admissions this cycle.  Evaluated once
         # at cycle start; held entries requeue with the waiting message
@@ -355,13 +362,24 @@ class Scheduler:
                 self._assign_entry(e, snapshot)
             return None
         if self.fair_sharing:
-            # fair-sharing cycles: device classification replaces the
-            # per-head flavor walk for Fit heads; the admit loop runs the
-            # host tournament (fair_sharing_iterator.go) — the within-
-            # cycle ordering is data-dependent on DRS, not scannable
-            solver.stats["classify_cycles"] += 1
-            self._assign_classified(deferred, cls, snapshot, set())
-            return None
+            # fair-sharing cycles: the tournament + admit loop runs as
+            # one device scan (ops/fs_scan.py) when every head is
+            # vector-classified and nothing needs preemption searches;
+            # otherwise device classification still replaces the
+            # per-head flavor walk and the host tournament decides
+            n = cls.n
+            fs_handle = None
+            if (not self._cycle_blocked
+                    and not cls.scalar_mask[:n].any()
+                    and not cls.preempt0[:n].any()):
+                fs_handle = solver.dispatch_fs(cls)
+            if fs_handle is None:
+                solver.stats["classify_cycles"] += 1
+                self._assign_classified(deferred, cls, snapshot, set())
+                return None
+            solver.stats["full_cycles"] += 1
+            solver.stats["fs_full_cycles"] += 1
+            return deferred, cls, fs_handle, {}, {}, set()
         n = cls.n
         reserve = np.zeros(n, dtype=bool)
         full_ok = True
@@ -782,6 +800,8 @@ class Scheduler:
                     tracker = None
                     break
                 vecs[cq_name] = vec
+        if tracker is None and self.fs_batched:
+            self.fs_stats["tracker_unavailable_cycles"] += 1
         self._fs_tracker = tracker
         try:
             while remaining:
@@ -809,6 +829,7 @@ class Scheduler:
                             drs_values[(tracker.names[par], wl_key)] = int(
                                 drs[j, level])
                 else:
+                    self.fs_stats["scalar_drs_rounds"] += 1
                     drs_values = self._fs_drs_values_ref(remaining)
                 winner = self._fs_tournament(cq.parent.root(), remaining,
                                              drs_values)

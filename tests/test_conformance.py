@@ -501,6 +501,12 @@ def test_fs_lowest_share_first(use_device):
     assert set(stats.admitted) == {"eng-alpha/new"}
     heap, parked = queue_state(d, "eng-beta")
     assert "eng-beta/older-new" in heap | parked
+    if use_device:
+        # eng-beta is a 2-resource-group CQ: its head is legitimately
+        # scalar, so this FS cycle runs the host tournament with device
+        # classification (the FULL-mode assertion lives in the
+        # hierarchical-tournament case, whose CQs are all vector-ok)
+        assert d.scheduler.solver.stats["classify_cycles"] > 0
 
 
 # --- :1569 "hierarchical fair sharing ... wins tournament" ---------------
@@ -545,6 +551,11 @@ def test_fs_hierarchical_tournament(use_device):
                     ("g", "eng-alpha/g1")):
         heap, parked = queue_state(d, cq)
         assert key in heap | parked, (cq, key)
+    if use_device:
+        # verdict r3 item 3: plain-admission FS cycles reach FULL mode
+        # on the device (the tournament ran in-scan)
+        assert d.scheduler.solver.stats["fs_full_cycles"] > 0, \
+            d.scheduler.solver.stats
 
 
 # --- :1681 "lowest drf after admission" ----------------------------------

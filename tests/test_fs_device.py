@@ -1,0 +1,205 @@
+"""Device fair-sharing parity: the in-scan tournament (ops/fs_scan.py)
+must produce bit-identical decisions to the host tournament path
+(fair_sharing_iterator.go semantics) — and fair-sharing cycles must
+actually reach FULL mode on the device (verdict r3 item 3).
+"""
+
+from __future__ import annotations
+
+from kueue_tpu.api.types import (
+    ClusterQueue,
+    FairSharing,
+    FlavorQuotas,
+    LocalQueue,
+    PodSet,
+    ResourceFlavor,
+    ResourceGroup,
+    ResourceQuota,
+    Workload,
+)
+from kueue_tpu.controller.driver import Driver
+
+
+class Clock:
+    def __init__(self, t=1000.0):
+        self.t = t
+
+    def __call__(self):
+        return self.t
+
+
+def build(spec_fn, use_device):
+    clock = Clock()
+    d = Driver(clock=clock, fair_sharing=True,
+               use_device_solver=use_device)
+    spec_fn(d)
+    return d, clock
+
+
+def mk(name, lq, cpu, prio=0, t=0.0):
+    return Workload(name=name, queue_name=lq, priority=prio,
+                    creation_time=t,
+                    pod_sets=[PodSet(name="main", count=1,
+                                     requests={"cpu": cpu})])
+
+
+def fs_cluster(weights=(1.0, 1.0, 1.0), nominal=2000, borrowing=8000,
+               cohorts=1):
+    def fn(d):
+        d.apply_resource_flavor(ResourceFlavor(name="default"))
+        for c in range(cohorts):
+            for q, w in enumerate(weights):
+                name = f"cq-{c}-{q}"
+                d.apply_cluster_queue(ClusterQueue(
+                    name=name, cohort=f"co-{c}",
+                    fair_sharing=FairSharing(weight=w),
+                    resource_groups=[ResourceGroup(
+                        covered_resources=["cpu"],
+                        flavors=[FlavorQuotas(name="default", resources={
+                            "cpu": ResourceQuota(
+                                nominal=nominal,
+                                borrowing_limit=borrowing)})])]))
+                d.apply_local_queue(LocalQueue(name=f"lq-{c}-{q}",
+                                               cluster_queue=name))
+    return fn
+
+
+def run_cycles(d, clock, cycles, runtime=0):
+    out = []
+    for c in range(cycles):
+        clock.t += 1.0
+        out.append(d.schedule_once())
+        if runtime > 0 and c - runtime >= 0:
+            for key in out[c - runtime].admitted:
+                wl = d.workloads.get(key)
+                if wl is not None and wl.has_quota_reservation:
+                    d.finish_workload(key)
+    return out
+
+
+def assert_fs_parity(spec_fn, wls, cycles, runtime=0,
+                     expect_full=True):
+    dh, ch = build(spec_fn, use_device=False)
+    dd, cd = build(spec_fn, use_device=True)
+    for d in (dh, dd):
+        for wl in wls:
+            d.create_workload(wl)
+    host = run_cycles(dh, ch, cycles, runtime)
+    dev = run_cycles(dd, cd, cycles, runtime)
+    for k, (h, v) in enumerate(zip(host, dev)):
+        assert h.admitted == v.admitted, \
+            f"cycle {k}: host={h.admitted} device={v.admitted}"
+        assert sorted(h.skipped) == sorted(v.skipped), f"cycle {k} skipped"
+        assert sorted(h.inadmissible) == sorted(v.inadmissible), \
+            f"cycle {k} inadmissible"
+    assert dh.admitted_keys() == dd.admitted_keys()
+    if expect_full:
+        assert dd.scheduler.solver.stats["fs_full_cycles"] > 0, \
+            dd.scheduler.solver.stats
+    return dd
+
+
+def test_fs_device_tournament_order():
+    """Three CQs borrowing from one cohort: DRS ordering decides who
+    admits first; admission order (the tournament sequence) must match
+    the host exactly, not just the admitted set."""
+    wls = []
+    for q in range(3):
+        for i in range(4):
+            wls.append(mk(f"w-{q}-{i}", f"lq-0-{q}", 1500,
+                          t=float(q * 10 + i)))
+    assert_fs_parity(fs_cluster(), wls, cycles=6)
+
+
+def test_fs_device_weights():
+    """Unequal fair weights bias the tournament; weight zero pins a CQ
+    to MAX_DRS (always last among borrowers)."""
+    wls = []
+    for q in range(3):
+        for i in range(3):
+            wls.append(mk(f"w-{q}-{i}", f"lq-0-{q}", 2500,
+                          t=float(q * 10 + i)))
+    assert_fs_parity(fs_cluster(weights=(2.0, 1.0, 0.0)), wls, cycles=6)
+
+
+def test_fs_device_priority_and_ts_ties():
+    """Equal DRS resolves by priority desc then timestamp asc then
+    structural child order — exact tie semantics."""
+    wls = [
+        mk("a", "lq-0-0", 3000, prio=5, t=7.0),
+        mk("b", "lq-0-1", 3000, prio=5, t=7.0),   # full tie vs a
+        mk("c", "lq-0-2", 3000, prio=9, t=9.0),   # higher priority
+    ]
+    assert_fs_parity(fs_cluster(), wls, cycles=3)
+
+
+def test_fs_device_nofit_entries_compete():
+    """NO_FIT entries still enter the tournament (with empty usage) and
+    are discarded when they win — the sequencing must match."""
+    wls = [
+        mk("big", "lq-0-0", 50_000, t=1.0),       # never fits
+        mk("ok-1", "lq-0-1", 2000, t=2.0),
+        mk("ok-2", "lq-0-2", 2000, t=3.0),
+    ]
+    assert_fs_parity(fs_cluster(), wls, cycles=3)
+
+
+def test_fs_device_multi_cohort_forest():
+    """Independent cohort forests: the tournament runs on the first
+    remaining entry's forest each round."""
+    wls = []
+    for c in range(3):
+        for q in range(3):
+            wls.append(mk(f"w-{c}-{q}", f"lq-{c}-{q}", 2500,
+                          t=float(c * 100 + q)))
+    assert_fs_parity(fs_cluster(cohorts=3), wls, cycles=5)
+
+
+def test_fs_device_drain_with_finishes():
+    """Multi-cycle FS drain with fake execution: usage-dependent DRS
+    keeps reordering the tournament as quota frees."""
+    wls = []
+    for q in range(3):
+        for i in range(5):
+            wls.append(mk(f"w-{q}-{i}", f"lq-0-{q}", 1800,
+                          t=float(q * 100 + i)))
+    dd = assert_fs_parity(fs_cluster(nominal=2000, borrowing=4000), wls,
+                          cycles=12, runtime=2)
+    # weak #8: the batched tracker must not silently fall back
+    assert dd.scheduler.fs_stats["scalar_drs_rounds"] == 0
+
+
+def test_fs_preemption_cycles_stay_host():
+    """FS cycles with preempt-capable heads keep the host path (the FS
+    preemption strategies are data-dependent) — decisions still match."""
+    from kueue_tpu.api.types import (PreemptionPolicy, ReclaimWithinCohort,
+                                     WithinClusterQueue)
+
+    def spec(d):
+        d.apply_resource_flavor(ResourceFlavor(name="default"))
+        for q in range(2):
+            d.apply_cluster_queue(ClusterQueue(
+                name=f"cq-0-{q}", cohort="co-0",
+                preemption=PreemptionPolicy(
+                    reclaim_within_cohort=ReclaimWithinCohort.ANY,
+                    within_cluster_queue=WithinClusterQueue.LOWER_PRIORITY),
+                resource_groups=[ResourceGroup(
+                    covered_resources=["cpu"],
+                    flavors=[FlavorQuotas(name="default", resources={
+                        "cpu": ResourceQuota(nominal=2000)})])]))
+            d.apply_local_queue(LocalQueue(name=f"lq-0-{q}",
+                                           cluster_queue=f"cq-0-{q}"))
+
+    dh, ch = build(spec, use_device=False)
+    dd, cd = build(spec, use_device=True)
+    for d, clock in ((dh, ch), (dd, cd)):
+        d.create_workload(mk("low", "lq-0-0", 2000, prio=0, t=1.0))
+        clock.t += 1.0
+        d.schedule_once()
+        d.create_workload(mk("high", "lq-0-0", 2000, prio=50, t=9.0))
+    host = run_cycles(dh, ch, 3)
+    dev = run_cycles(dd, cd, 3)
+    for h, v in zip(host, dev):
+        assert h.admitted == v.admitted
+        assert sorted(h.preempted_targets) == sorted(v.preempted_targets)
+    assert dh.admitted_keys() == dd.admitted_keys()
