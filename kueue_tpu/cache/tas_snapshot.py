@@ -171,15 +171,19 @@ class TASFlavorSnapshot:
     # -- device kernel path (ops/tas_kernel, TASDeviceKernel gate) -----
 
     def _device_kernel_eligible(self, request: PodSetTopologyRequest) -> bool:
-        """The batched kernel implements the default BestFit profile;
-        the TASProfile* gates (including Mixed's unconstrained variant)
-        keep the scalar tree walk."""
+        """The batched kernel implements all three TAS profiles
+        (BestFit default, TASProfileMostFreeCapacity,
+        TASProfileLeastFreeCapacity incl. Mixed's unconstrained
+        variant — tas_flavor_snapshot.go:551-568)."""
         from .. import features
-        unconstrained = bool(request.unconstrained)
-        return (features.enabled("TASDeviceKernel")
-                and self._use_best_fit(unconstrained)
-                and not self._use_least_free(unconstrained)
-                and bool(self.leaves))
+        return features.enabled("TASDeviceKernel") and bool(self.leaves)
+
+    def _device_profile(self, unconstrained: bool) -> str:
+        if self._use_best_fit(unconstrained):
+            return "bestfit"
+        if self._use_least_free(unconstrained):
+            return "leastfree"
+        return "mostfree"
 
     def _find_device(self, count: int, per_pod: dict[str, int],
                      request: PodSetTopologyRequest,
@@ -243,9 +247,12 @@ class TASFlavorSnapshot:
             return TopologyAssignment(levels=list(self.levels),
                                       domains=domains)
 
+        profile = self._device_profile(False)
         if request.unconstrained:
             ok, counts = tk.split_across_roots(
-                leaf_free, per_pod_vec, parents, count, level_sizes=sizes)
+                leaf_free, per_pod_vec, parents, count, level_sizes=sizes,
+                profile=self._device_profile(True),
+                descend_profile=profile)
             if not bool(ok):
                 return None, self._fit_message(count, total_fit())
             return finish(counts), ""
@@ -253,7 +260,7 @@ class TASFlavorSnapshot:
         if required_idx is not None:
             ok, counts = tk.best_fit_descend(
                 leaf_free, per_pod_vec, parents, count,
-                level_sizes=sizes, level=required_idx)
+                level_sizes=sizes, level=required_idx, profile=profile)
             if not bool(ok):
                 # host message reads Domain.state, unfilled on this path:
                 # compute the best single-domain fit from kernel states
@@ -269,11 +276,12 @@ class TASFlavorSnapshot:
         for lvl in range(start, -1, -1):
             ok, counts = tk.best_fit_descend(
                 leaf_free, per_pod_vec, parents, count,
-                level_sizes=sizes, level=lvl)
+                level_sizes=sizes, level=lvl, profile=profile)
             if bool(ok):
                 return finish(counts), ""
         ok, counts = tk.split_across_roots(
-            leaf_free, per_pod_vec, parents, count, level_sizes=sizes)
+            leaf_free, per_pod_vec, parents, count, level_sizes=sizes,
+            profile=profile)
         if not bool(ok):
             return None, self._fit_message(count, total_fit())
         return finish(counts), ""

@@ -46,8 +46,9 @@ DEFAULT_FEATURE_GATES: dict[str, FeatureSpec] = {
     "TASProfileLeastFreeCapacity": FeatureSpec(False, "Alpha"),
     "TASProfileMixed": FeatureSpec(False, "Alpha"),
     # kueue-tpu extension: route find_topology_assignment through the
-    # batched ops/tas_kernel (default BestFit profile only)
-    "TASDeviceKernel": FeatureSpec(False, "Alpha"),
+    # batched segment-tree kernel (ops/tas_kernel) — implements all
+    # three TAS profiles, bit-matching the scalar tree walk
+    "TASDeviceKernel": FeatureSpec(True, "Beta"),
 }
 
 _overrides: dict[str, bool] = {}

@@ -101,11 +101,15 @@ def fill_counts(leaf_free, per_pod, parents, *, level_sizes: tuple[int, ...]):
 # Phase 2: best-fit selection + descent
 # ---------------------------------------------------------------------------
 
-def _best_at_level(state, count):
-    """Least spare capacity among domains fitting `count`; ties by index
-    (= id order).  Returns -1 when none fits."""
+def _best_at_level(state, count, profile: str):
+    """Single fitting domain per profile (_find_fit_at): BestFit and
+    LeastFree pick the least spare capacity, MostFree the most free —
+    ties by index (= id order).  Returns -1 when none fits."""
     fits = state >= count
-    key = jnp.where(fits, state, I32_MAX)
+    if profile == "mostfree":
+        key = jnp.where(fits, -state, I32_MAX)
+    else:
+        key = jnp.where(fits, state, I32_MAX)
     best = jnp.argmin(key)                        # ties → lowest index
     return jnp.where(jnp.any(fits), best, -1)
 
@@ -131,18 +135,23 @@ def _seg_broadcast_max(values, first_of_seg):
     return out
 
 
-def _allocate_level(parent_counts, par, state):
-    """Distribute parent counts over children in (-state, idx) order with
-    the reference's best-fit last-domain optimization
+def _allocate_level(parent_counts, par, state, profile: str = "bestfit"):
+    """Distribute parent counts over children in sortedDomains order
     (updateCountsToMinimum, tas_flavor_snapshot.go:571): take whole
-    domains largest-first; once the remainder fits a single domain, give
-    it to the tightest domain that still fits it.
+    domains in profile order; under BestFit, once the remainder fits a
+    single domain, give it to the tightest domain that still fits it
+    (MostFree/LeastFree hand the remainder to the first in-order fit —
+    the plain greedy walk).  LeastFree reverses the (-state, id) order,
+    so equal-state ties run in id-DESCENDING order there.
 
     parent_counts: [N_l]; par: [N_{l+1}] parent idx; state: [N_{l+1}].
     Returns child_counts [N_{l+1}].
     """
     n = state.shape[0]
-    order = jnp.lexsort((jnp.arange(n), -state, par))   # group, then -state
+    if profile == "leastfree":
+        order = jnp.lexsort((-jnp.arange(n), state, par))
+    else:
+        order = jnp.lexsort((jnp.arange(n), -state, par))  # group, -state
     par_o = par[order]
     state_o = state[order]
     first = jnp.concatenate([jnp.array([True]), par_o[1:] != par_o[:-1]])
@@ -175,47 +184,63 @@ def _allocate_level(parent_counts, par, state):
     is_pick = tight & (tight_count == 1)
 
     greedy = jnp.clip(remaining, 0, state_o)             # also covers k < j
-    take_o = jnp.where(has_j, jnp.where(is_pick, rem_j, 0), greedy)
+    if profile == "bestfit":
+        take_o = jnp.where(has_j, jnp.where(is_pick, rem_j, 0), greedy)
+    else:
+        take_o = greedy      # _select_from without the last-domain pick
     out = jnp.zeros(n, dtype=parent_counts.dtype).at[order].set(take_o)
     return out
 
 
-@partial(jax.jit, static_argnames=("level_sizes", "level"))
+@partial(jax.jit, static_argnames=("level_sizes", "level", "profile"))
 def best_fit_descend(leaf_free, per_pod, parents, count,
-                     *, level_sizes: tuple[int, ...], level: int):
-    """Single-domain BestFit at `level` + descent to leaf counts.
+                     *, level_sizes: tuple[int, ...], level: int,
+                     profile: str = "bestfit"):
+    """Single-domain selection at `level` + descent to leaf counts,
+    under the requested TAS profile (tas_flavor_snapshot.go:551-568).
 
     Returns (ok bool, leaf_counts [N_leaf] int32); ok=False when no
     single domain at `level` fits `count`."""
     states = fill_counts(leaf_free, per_pod, parents,
                          level_sizes=level_sizes)
-    best = _best_at_level(states[level], count)
+    best = _best_at_level(states[level], count, profile)
     ok = best >= 0
     counts = jnp.zeros(level_sizes[level], dtype=jnp.int32)
     counts = counts.at[jnp.maximum(best, 0)].set(
         jnp.where(ok, count, 0).astype(jnp.int32))
     for lvl in range(level, len(level_sizes) - 1):
-        counts = _allocate_level(counts, parents[lvl], states[lvl + 1])
+        counts = _allocate_level(counts, parents[lvl], states[lvl + 1],
+                                 profile)
     return ok, counts
 
 
-@partial(jax.jit, static_argnames=("level_sizes",))
+@partial(jax.jit,
+         static_argnames=("level_sizes", "profile", "descend_profile"))
 def split_across_roots(leaf_free, per_pod, parents, count,
-                       *, level_sizes: tuple[int, ...]):
-    """The unconstrained / final-fallback path: split over root domains,
-    largest first (reference `unconstrained` + root split), then descend.
+                       *, level_sizes: tuple[int, ...],
+                       profile: str = "bestfit",
+                       descend_profile: str | None = None):
+    """The unconstrained / final-fallback path: split over root domains
+    in ``profile`` order (reference `unconstrained` + root split), then
+    descend in ``descend_profile`` order.  They differ only under the
+    Mixed gate: its unconstrained variant selects roots least-free but
+    the per-level descent (_descend -> _sorted_domains without the
+    unconstrained flag) stays on the non-unconstrained profile.
 
     Returns (ok, leaf_counts)."""
+    if descend_profile is None:
+        descend_profile = profile
     states = fill_counts(leaf_free, per_pod, parents,
                          level_sizes=level_sizes)
     root_state = states[0]
     total = jnp.sum(root_state)
     ok = total >= count
-    # roots form one segment: largest-first with best-fit last domain
     n = root_state.shape[0]
     counts = _allocate_level(jnp.array([count], dtype=jnp.int32),
-                             jnp.zeros(n, dtype=jnp.int32), root_state)
+                             jnp.zeros(n, dtype=jnp.int32), root_state,
+                             profile)
     counts = jnp.where(ok, counts, 0)
     for lvl in range(0, len(level_sizes) - 1):
-        counts = _allocate_level(counts, parents[lvl], states[lvl + 1])
+        counts = _allocate_level(counts, parents[lvl], states[lvl + 1],
+                                 descend_profile)
     return ok, counts
