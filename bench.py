@@ -69,6 +69,19 @@ def build(scale: float):
     clock = VirtualClock()
     d = Driver(clock=clock,
                use_device_solver=os.environ.get("BENCH_DEVICE", "1") == "1")
+    mesh_n = int(os.environ.get("BENCH_MESH", "0"))
+    if mesh_n > 1:
+        if d.scheduler.solver is None:
+            raise SystemExit("BENCH_MESH requires BENCH_DEVICE=1 "
+                             "(the mesh shards the device solver)")
+        # mesh-sharded production dispatch (BENCH_MESH=N; on a CPU-only
+        # box export XLA_FLAGS=--xla_force_host_platform_device_count=N).
+        # NOTE: warmup pre-compiles the unsharded kernels; the sharded
+        # variants compile on first use, so the first cycles of a mesh
+        # run include jit compilation (mesh numbers are a scaling
+        # artifact, not the headline benchmark).
+        from kueue_tpu.parallel import make_mesh
+        d.scheduler.solver.set_mesh(make_mesh(mesh_n))
     d.apply_resource_flavor(ResourceFlavor(name="default"))
     total = 0
     waves: dict[str, list[Workload]] = {c[0]: [] for c in CLASSES}

@@ -81,3 +81,50 @@ def sharded_cycle_fn(mesh: Mesh, depth: int, run_scan: bool = True):
         return solve_cycle(*args, depth=depth, run_scan=run_scan)
 
     return jax.jit(step, in_shardings=in_shardings)
+
+
+# ---------------------------------------------------------------------------
+# Production admit-scan sharding (CycleSolver.set_mesh routing)
+# ---------------------------------------------------------------------------
+
+def admit_scan_fns(mesh: Mesh, depth: int):
+    """Factory for mesh-bound jitted variants of the production admit
+    scans (ops.cycle.admit_scan{,_forests,_preempt}) with the standard
+    shardings: quota plane over ``cq``, per-head tensors over ``wl``,
+    the preemption-target universe replicated (targets are shared state
+    every step may touch).  Returns {name: fn} with the same positional
+    signatures as the unsharded kernels (statics bound per call via the
+    ``forests``/``preempt`` wrappers)."""
+    from ..ops.cycle import admit_scan, admit_scan_forests, admit_scan_preempt
+
+    node = NamedSharding(mesh, P("cq"))
+    rep = NamedSharding(mesh, P())
+    wl = NamedSharding(mesh, P("wl"))
+    # admit_scan(usage0, subtree, guaranteed, borrow_cap, has_blim,
+    #            parent, nominal_cq, npb_cq, wl_cq, dec_fr, dec_amt,
+    #            fit_mask, res_fr, res_amt, res_mask, res_borrows, order)
+    base = (node, node, node, node, node, rep, node, node,
+            wl, wl, wl, wl, wl, wl, wl, wl)
+
+    flat = jax.jit(lambda *a: admit_scan(*a, depth=depth),
+                   in_shardings=base + (wl,))
+
+    forest_cache: dict = {}
+
+    def forests(*args, forest_of_node, n_forests, max_forest_wl):
+        key = (n_forests, max_forest_wl)
+        fn = forest_cache.get(key)
+        if fn is None:
+            fn = jax.jit(
+                lambda *a: admit_scan_forests(
+                    *a, depth=depth, n_forests=n_forests,
+                    max_forest_wl=max_forest_wl),
+                in_shardings=base + (wl, rep))
+            forest_cache[key] = fn
+        return fn(*args, forest_of_node)
+
+    preempt = jax.jit(
+        lambda *a: admit_scan_preempt(*a, depth=depth),
+        in_shardings=base + (wl, wl, wl, wl, rep, rep, wl))
+
+    return {"flat": flat, "forest": forests, "preempt": preempt}

@@ -1,0 +1,99 @@
+"""Production mesh routing: CycleSolver.set_mesh makes the real
+dispatch path run sharded admit scans (flat/forest/preempt) over the
+(wl, cq) mesh with exact decision parity vs the unmeshed solver
+(verdict r3 item 5 — the sharded cycle is the production path, not a
+dryrun-only artifact).  Runs on the conftest's 8 virtual CPU devices.
+"""
+
+from __future__ import annotations
+
+from kueue_tpu.api.types import (
+    ClusterQueue,
+    FlavorQuotas,
+    LocalQueue,
+    PodSet,
+    PreemptionPolicy,
+    ReclaimWithinCohort,
+    ResourceFlavor,
+    ResourceGroup,
+    ResourceQuota,
+    WithinClusterQueue,
+    Workload,
+)
+from kueue_tpu.controller.driver import Driver
+from kueue_tpu.parallel import make_mesh
+
+
+class Clock:
+    def __init__(self, t=1000.0):
+        self.t = t
+
+    def __call__(self):
+        return self.t
+
+
+def build(mesh=None):
+    clock = Clock()
+    d = Driver(clock=clock, use_device_solver=True)
+    if mesh is not None:
+        d.scheduler.solver.set_mesh(mesh)
+    d.apply_resource_flavor(ResourceFlavor(name="default"))
+    pre = PreemptionPolicy(
+        reclaim_within_cohort=ReclaimWithinCohort.ANY,
+        within_cluster_queue=WithinClusterQueue.LOWER_PRIORITY)
+    for c in range(4):
+        for q in range(2):
+            name = f"cq-{c}-{q}"
+            d.apply_cluster_queue(ClusterQueue(
+                name=name, cohort=f"co-{c}", preemption=pre,
+                resource_groups=[ResourceGroup(
+                    covered_resources=["cpu"],
+                    flavors=[FlavorQuotas(name="default", resources={
+                        "cpu": ResourceQuota(nominal=4000,
+                                             borrowing_limit=4000)})])]))
+            d.apply_local_queue(LocalQueue(name=f"lq-{c}-{q}",
+                                           cluster_queue=name))
+    n = 0
+    for c in range(4):
+        for q in range(2):
+            for i in range(5):
+                n += 1
+                d.create_workload(Workload(
+                    name=f"w-{c}-{q}-{i}", queue_name=f"lq-{c}-{q}",
+                    priority=(i % 2) * 10, creation_time=float(n),
+                    pod_sets=[PodSet(name="main", count=1,
+                                     requests={"cpu": 1500})]))
+    return d, clock
+
+
+def wave(d):
+    for c in range(4):
+        # boss fits nominal quota (preempt-capable within its CQ) but
+        # not current availability -> real preemption targets
+        d.create_workload(Workload(
+            name=f"boss-{c}", queue_name=f"lq-{c}-0", priority=100,
+            creation_time=500.0 + c,
+            pod_sets=[PodSet(name="main", count=1,
+                             requests={"cpu": 4000})]))
+
+
+def test_mesh_routed_production_cycles_match_unmeshed():
+    mesh = make_mesh(8)
+    dm, cm = build(mesh)
+    du, cu = build(None)
+    for cyc in range(6):
+        if cyc == 2:
+            wave(dm)
+            wave(du)
+        cm.t += 1.0
+        cu.t += 1.0
+        sm = dm.schedule_once()
+        su = du.schedule_once()
+        assert sm.admitted == su.admitted, cyc
+        assert sorted(sm.preempted_targets) == sorted(su.preempted_targets)
+        assert sorted(sm.skipped) == sorted(su.skipped)
+        assert sorted(sm.inadmissible) == sorted(su.inadmissible)
+    stats = dm.scheduler.solver.stats
+    assert stats.get("sharded_dispatches", 0) > 0, stats
+    assert stats.get("sharded_preempt_dispatches", 0) > 0, stats
+    assert dm.admitted_keys() == du.admitted_keys()
