@@ -54,6 +54,7 @@ class WorkerCluster:
     lost_since: Optional[float] = None
     next_retry: float = 0.0
     retry_backoff: float = RETRY_BASE_S
+    watch: object = None              # remote.WatchLoop when streaming
 
     def __post_init__(self):
         if self.client is None and self.driver is not None:
@@ -140,17 +141,81 @@ class MultiKueueController:
             cluster.mark_lost(self.manager.clock())
             return default
 
+    def start_watches(self, poll_timeout: float = 10.0) -> None:
+        """Per-cluster watch streams (reference multikueuecluster.go:187
+        watch channels): worker events are pushed to the controller
+        instead of polled one GET per assigned workload per reconcile.
+        Re-establishment + event replay are handled by the WatchLoop."""
+        from ..remote import WatchLoop
+        for cluster in self.clusters.values():
+            if cluster.watch is None and cluster.client is not None:
+                cluster.watch = WatchLoop(cluster.client,
+                                          poll_timeout=poll_timeout)
+                cluster.watch.start()
+
+    def stop_watches(self) -> None:
+        for cluster in self.clusters.values():
+            if cluster.watch is not None:
+                cluster.watch.stop()
+                cluster.watch = None
+
+    def _drain_watches(self, now: float) -> list[tuple[str, str]]:
+        """Pull pending events from every watch queue.  Connection
+        markers drive the cluster's lost/reconnected state; workload
+        events return as (cluster, key) for targeted syncs."""
+        import queue as _queue
+        touched: list[tuple[str, str]] = []
+        for cname, cluster in self.clusters.items():
+            w = cluster.watch
+            if w is None:
+                continue
+            while True:
+                try:
+                    kind, key, _note = w.events.get_nowait()
+                except _queue.Empty:
+                    break
+                if kind == "__lost__":
+                    cluster.mark_lost(now)
+                elif kind == "__reconnected__":
+                    was_lost = not cluster.active
+                    cluster.reconnect()
+                    if was_lost:
+                        self._flush_pending_deletes(cname)
+                elif kind == "__resync__":
+                    # fresh worker epoch: the remote may have lost every
+                    # mirror — resync everything tied to this cluster
+                    for akey, asg in self.assignments.items():
+                        if asg.cluster == cname or cname in asg.nominated:
+                            touched.append((cname, akey))
+                elif kind in ("QuotaReserved", "Finished", "Deleted",
+                              "Preempted"):
+                    touched.append((cname, key))
+        return touched
+
     def reconcile(self) -> None:
         now = self.manager.clock()
-        # connection health: retry lost workers with exponential backoff,
-        # eject assignments once a worker stays lost past the timeout
+        touched = self._drain_watches(now)
+        # connection health: the watch loop is authoritative when
+        # streaming; otherwise retry lost workers with exponential
+        # backoff.  Either way, eject assignments once a worker stays
+        # lost past the timeout.
         for name, cluster in self.clusters.items():
+            # health probes run even with a watch attached: a transient
+            # _worker_op failure can mark the cluster lost while the
+            # watch stream (a separate connection) stays healthy and so
+            # never emits a __reconnected__ marker
             if not cluster.active and cluster.try_reconnect(now):
                 self._flush_pending_deletes(name)
             if (not cluster.active and cluster.lost_since is not None
                     and now - cluster.lost_since > self.worker_lost_timeout):
                 self._eject_cluster(name)
 
+        # with watches, remote state arrives as events: the per-workload
+        # GET polling loop runs only for watchless transports (and for
+        # job-level dispatch, whose execution-status copy-back has no
+        # event source)
+        watching = all(c.watch is not None
+                       for c in self.clusters.values()) and self.clusters
         for key, wl in list(self.manager.workloads.items()):
             if not self._relevant(wl):
                 if key in self.assignments:
@@ -160,7 +225,18 @@ class MultiKueueController:
             asg = self.assignments.get(key)
             if asg is None:
                 self._nominate(key, wl)
-            else:
+            elif not watching or self.manager_jobs is not None:
+                self._sync(key, wl, state.state, asg)
+
+        if watching and self.manager_jobs is None:
+            # targeted event-driven syncs (deduped; when the polling
+            # loop ran above it already covered every assignment)
+            for key in dict.fromkeys(k for _c, k in touched):
+                asg = self.assignments.get(key)
+                wl = self.manager.workloads.get(key)
+                if asg is None or wl is None or not self._relevant(wl):
+                    continue
+                state = wl.admission_check_states[self.check_name]
                 self._sync(key, wl, state.state, asg)
 
     # ------------------------------------------------------------------

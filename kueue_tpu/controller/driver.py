@@ -276,6 +276,7 @@ class Driver:
         if wl.admission is not None:
             self.cache.delete_workload(Info(wl))
             self.queues.queue_inadmissible_workloads([wl.admission.cluster_queue])
+        self.events.append(("Deleted", key, ""))
         self.wake_gate_blocked()   # deleting a not-ready blocker opens the gate
 
     def finish_workload(self, key: str, message: str = "Job finished") -> None:
@@ -311,6 +312,7 @@ class Driver:
                     seen.add(cq_name)
                     touched.append(cq_name)
             self.queues.delete_workload(wl)
+            self.events.append(("Finished", key, message))
             any_done = True
         if touched:
             self.queues.queue_inadmissible_workloads(touched)

@@ -67,6 +67,92 @@ class LocalWorkerClient:
         return {k: wl.is_finished
                 for k, wl in list(self.driver.workloads.items())}
 
+    def watch_events(self, since: int, timeout: float = 0.0):
+        """In-process watch: read the driver's append-only event log
+        from the resume token (no blocking — the caller polls)."""
+        if not self.ok:
+            raise ConnectionLost("watch: worker down")
+        events = self.driver.events
+        batch = [tuple(e) for e in events[since:]]
+        return batch, since + len(batch), str(id(self.driver))
+
+
+class WatchLoop:
+    """Manager-side per-cluster watch thread (reference
+    multikueuecluster.go:187-226 watch re-establishment).
+
+    Long-polls the worker's event stream and pushes (kind, key, note)
+    tuples into a thread-safe queue the controller drains on reconcile;
+    connection loss pushes a ``("__lost__", ...)`` marker, then the loop
+    keeps retrying with exponential backoff and pushes
+    ``("__reconnected__", ...)`` when the stream is back — resuming from
+    the last seen token, so every missed event is replayed."""
+
+    def __init__(self, client, poll_timeout: float = 10.0):
+        import queue as _queue
+        self.client = client
+        self.poll_timeout = poll_timeout
+        self.events: "_queue.Queue" = _queue.Queue()
+        self.since = 0
+        self._epoch = None
+        self._stop = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+        self._was_lost = False
+
+    def start(self) -> None:
+        self._thread = threading.Thread(target=self._run, daemon=True)
+        self._thread.start()
+
+    def stop(self) -> None:
+        self._stop.set()
+        if self._thread is not None:
+            self._thread.join(timeout=5.0)
+
+    def _run(self) -> None:
+        backoff = 0.2
+        while not self._stop.is_set():
+            try:
+                batch, nxt, epoch = self._poll()
+            except Exception as e:
+                # ANY failure is a connection loss (a dead watch thread
+                # would silently stop all sync for the cluster)
+                if not self._was_lost:
+                    self._was_lost = True
+                    self.events.put(("__lost__", "", str(e)))
+                self._stop.wait(backoff)
+                backoff = min(backoff * 2.0, 30.0)
+                continue
+            if (epoch is not None and self._epoch is not None
+                    and epoch != self._epoch):
+                # the worker restarted with a fresh event log: the resume
+                # token is meaningless — replay from 0 and tell the
+                # controller to resync this cluster's assignments
+                self._epoch = epoch
+                self.since = 0
+                self.events.put(("__resync__", "", ""))
+                continue
+            if epoch is not None:
+                self._epoch = epoch
+            if self._was_lost:
+                self._was_lost = False
+                self.events.put(("__reconnected__", "", ""))
+            backoff = 0.2
+            self.since = nxt
+            for ev in batch:
+                self.events.put(tuple(ev))
+            if not batch:
+                # blocking clients already waited out the long poll; the
+                # in-process client returns instantly — pace either way
+                self._stop.wait(0.05)
+
+    def _poll(self):
+        out = self.client.watch_events(self.since,
+                                       timeout=self.poll_timeout)
+        if len(out) == 3:
+            return out
+        batch, nxt = out
+        return batch, nxt, None
+
 
 class HttpWorkerClient:
     """Manager-side remote client (multikueuecluster.go remoteClient).
@@ -79,7 +165,8 @@ class HttpWorkerClient:
         self.base_url = base_url.rstrip("/")
         self.timeout = timeout
 
-    def _request(self, method: str, path: str, body: Optional[dict] = None):
+    def _request(self, method: str, path: str, body: Optional[dict] = None,
+                 timeout_override: Optional[float] = None):
         import urllib.error
         import urllib.request
         data = None if body is None else json.dumps(body).encode()
@@ -87,7 +174,8 @@ class HttpWorkerClient:
             self.base_url + path, data=data, method=method,
             headers={"Content-Type": "application/json"})
         try:
-            with urllib.request.urlopen(req, timeout=self.timeout) as resp:
+            with urllib.request.urlopen(
+                    req, timeout=timeout_override or self.timeout) as resp:
                 payload = resp.read()
                 return json.loads(payload) if payload else None
         except urllib.error.HTTPError as e:
@@ -100,6 +188,13 @@ class HttpWorkerClient:
             raise ConnectionLost(f"{method} {path}: HTTP {e.code}") from e
         except OSError as e:               # refused / reset / timeout
             raise ConnectionLost(f"{method} {path}: {e}") from e
+        except Exception as e:
+            # http.client.IncompleteRead/BadStatusLine etc.: a worker
+            # dying mid-response is a transport failure, not a crash
+            import http.client
+            if isinstance(e, http.client.HTTPException):
+                raise ConnectionLost(f"{method} {path}: {e}") from e
+            raise
 
     def healthy(self) -> bool:
         try:
@@ -138,6 +233,18 @@ class HttpWorkerClient:
         self._request("POST", f"/apis/workloads/{ns}/{name}/finish",
                       {"message": message})
 
+    def watch_events(self, since: int, timeout: float = 20.0):
+        """Long-poll the worker's event stream from resume token
+        ``since``.  Returns (events, next_token); blocks worker-side
+        until events exist or the poll times out."""
+        out = self._request(
+            "GET", f"/apis/watch?since={since}&timeout={timeout}",
+            timeout_override=timeout + self.timeout)
+        if out is None:
+            return [], since, None
+        return ([tuple(e) for e in out.get("events", [])],
+                int(out.get("next", since)), out.get("epoch"))
+
 
 class _Handler(BaseHTTPRequestHandler):
     driver = None  # bound by WorkerServer
@@ -164,6 +271,26 @@ class _Handler(BaseHTTPRequestHandler):
     def do_GET(self):
         if self.path == "/healthz":
             self._send(200, {"ok": True})
+            return
+        if self.path.startswith("/apis/watch"):
+            # long-poll watch stream (reference multikueuecluster.go:187
+            # per-cluster watch channels): the driver's append-only event
+            # log is the resume token space — ?since=N returns events[N:]
+            # as soon as any exist (or an empty batch on timeout), so a
+            # reconnecting manager replays everything it missed
+            from urllib.parse import parse_qs, urlparse
+            q = parse_qs(urlparse(self.path).query)
+            since = int(q.get("since", ["0"])[0])
+            timeout = min(30.0, float(q.get("timeout", ["20"])[0]))
+            import time as _time
+            deadline = _time.monotonic() + timeout
+            events = self.driver.events
+            while len(events) <= since and _time.monotonic() < deadline:
+                _time.sleep(0.02)
+            batch = [list(e) for e in events[since:]]
+            self._send(200, {"events": batch,
+                             "next": since + len(batch),
+                             "epoch": self.server.epoch})
             return
         if self.path.rstrip("/") == "/apis/workloads":
             items = list(self.driver.workloads.items())
@@ -218,8 +345,13 @@ class WorkerServer:
     """The worker-side HTTP API, served next to the admission daemon."""
 
     def __init__(self, driver, port: int = 0, host: str = "127.0.0.1"):
+        import uuid
         handler = type("BoundHandler", (_Handler,), {"driver": driver})
         self.httpd = ThreadingHTTPServer((host, port), handler)
+        # watch-log epoch: a restarted worker process serves a fresh
+        # (shorter) event log, so resume tokens from the old epoch must
+        # trigger a replay-from-zero + resync instead of silent skips
+        self.httpd.epoch = uuid.uuid4().hex
         self.port = self.httpd.server_address[1]
         self._thread: Optional[threading.Thread] = None
 
