@@ -51,7 +51,8 @@ def load_generator_config(path: str) -> list[dict]:
 class PerfStats:
     wall_ms: float = 0.0
     virtual_ms: float = 0.0
-    cpu_mcpu: float = 0.0
+    cpu_mcpu: float = 0.0         # cpu_s per arrival-schedule second
+    cpu_mcpu_replay: float = 0.0  # cpu_s per compressed replay second
     maxrss_kb: float = 0.0
     total_workloads: int = 0
     admitted: int = 0
@@ -199,7 +200,19 @@ def run_scenario(config: list[dict], driver: Driver | None = None) -> PerfStats:
     stats.virtual_ms = last_t
     stats.wall_ms = (time.perf_counter() - wall0) * 1000.0
     cpu_s = time.process_time() - cpu0
-    stats.cpu_mcpu = (cpu_s / max(stats.wall_ms / 1000.0, 1e-9)) * 1000.0
+    # Two CPU figures, because the reference's 396-535 mCPU is measured
+    # over an ARRIVAL-PACED run (wall ~= the generator schedule, the
+    # process mostly idle between events).  The comparable number for a
+    # virtual-time replay is cpu seconds per SCHEDULE second — what the
+    # process would consume if arrivals were paced in real time (the
+    # work is identical; only the idle gaps are compressed).  The replay
+    # figure divides by compressed wall time and is ~1000 mCPU for any
+    # CPU-bound replay by construction.  Degenerate all-at-t0 schedules
+    # (virtual_ms ~ 0) fall back to the wall denominator.
+    denom_s = max(stats.virtual_ms, stats.wall_ms) / 1000.0
+    stats.cpu_mcpu = cpu_s / max(denom_s, 1e-9) * 1000.0
+    stats.cpu_mcpu_replay = (
+        cpu_s / max(stats.wall_ms / 1000.0, 1e-9)) * 1000.0
     stats.maxrss_kb = resource.getrusage(resource.RUSAGE_SELF).ru_maxrss
     for cls, total in adm_sum.items():
         stats.avg_time_to_admission_ms[cls] = total / adm_count[cls]
@@ -216,8 +229,9 @@ def check_rangespec(stats: PerfStats, rangespec: dict) -> list[str]:
     cmd = rangespec.get("cmd", {})
     if "maxWallMs" in cmd and stats.wall_ms > cmd["maxWallMs"]:
         failures.append(f"wall {stats.wall_ms:.0f}ms > {cmd['maxWallMs']}ms")
-    if "mCPU" in cmd and stats.cpu_mcpu > cmd["mCPU"] * 1.5:
-        # allow headroom: our process includes the harness itself
+    if "mCPU" in cmd and stats.cpu_mcpu > cmd["mCPU"]:
+        # vs the arrival schedule (see run()): directly comparable to
+        # the reference's paced-run measurement, no headroom needed
         failures.append(f"cpu {stats.cpu_mcpu:.0f}mCPU > {cmd['mCPU']}")
     if "maxrss" in cmd and stats.maxrss_kb > cmd["maxrss"]:
         failures.append(f"rss {stats.maxrss_kb:.0f}KB > {cmd['maxrss']}KB")
@@ -245,6 +259,7 @@ def main(argv: list[str]) -> int:
         "wall_ms": round(stats.wall_ms, 1),
         "virtual_ms": round(stats.virtual_ms, 1),
         "cpu_mcpu": round(stats.cpu_mcpu, 1),
+        "cpu_mcpu_replay": round(stats.cpu_mcpu_replay, 1),
         "maxrss_kb": stats.maxrss_kb,
         "workloads": stats.total_workloads,
         "finished": stats.finished,

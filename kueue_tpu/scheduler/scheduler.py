@@ -926,6 +926,9 @@ class Scheduler:
     def _checks_for(self, cq: CQState, assignment: Assignment) -> list[str]:
         """AdmissionChecks + per-flavor strategy rules (reference
         workload.AdmissionChecksForWorkload)."""
+        if not cq.spec.admission_checks and \
+                not cq.spec.admission_checks_strategy:
+            return []
         checks = list(cq.spec.admission_checks)
         used_flavors = {fa.name for ps in assignment.pod_sets
                         for fa in ps.flavors.values()}

@@ -107,6 +107,10 @@ class Info:
     def __init__(self, wl: Workload, opts: InfoOptions | None = None):
         self.obj = wl
         self.opts = opts or InfoOptions()
+        # plain attribute, not a property: info.key is on several hot
+        # paths (heap comparators, dict routing) and the workload's key
+        # is immutable per Info instance
+        self.key: str = wl.key
         self.cluster_queue: str = wl.admission.cluster_queue if wl.admission else ""
         self.total_requests: list[PodSetResources] = self._compute_total_requests()
         # Flavor-assignment resume state (reference workload.go:82
@@ -174,15 +178,12 @@ class Info:
         return total
 
     @property
-    def key(self) -> str:
-        return self.obj.key
-
-    @property
     def priority(self) -> int:
         return self.obj.priority
 
     def update_from(self, wl: Workload) -> None:
         self.obj = wl
+        self.key = wl.key
         self.cluster_queue = wl.admission.cluster_queue if wl.admission else self.cluster_queue
         self.total_requests = self._compute_total_requests()
 
