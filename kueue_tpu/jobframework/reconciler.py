@@ -178,6 +178,14 @@ class JobManager:
         self.jobs: dict[str, GenericJob] = {}
 
     def upsert(self, job: GenericJob) -> None:
+        """Admit a job object through the webhook chain, then reconcile
+        (the controller-runtime webhook → watch → reconcile path)."""
+        from .webhook import validate_job_create, validate_job_update
+        old = self.jobs.get(job.key)
+        if old is None or old is job:
+            validate_job_create(job)
+        else:
+            validate_job_update(old, job)
         self.jobs[job.key] = job
         self.reconciler.reconcile(job)
 

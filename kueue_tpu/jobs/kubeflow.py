@@ -33,6 +33,9 @@ class KubeflowJob(TemplateJob):
     # roles ordered first in the workload's pod sets (reference orders
     # Master before Worker for stable PodSet naming)
     role_order: tuple[str, ...] = ()
+    # the kind's replica-specs field (reference ReplicaSpecsFieldName,
+    # e.g. tfjob_controller.go:116 "tfReplicaSpecs")
+    replica_specs_field: str = "replicaSpecs"
 
     def __init__(self, name: str, replicas: list[ReplicaSpec], **kw):
         order = {r: i for i, r in enumerate(self.role_order)}
@@ -58,35 +61,62 @@ class KubeflowJob(TemplateJob):
         message, success = self.condition
         return message, success, True
 
+    def validate_on_create(self) -> list[str]:
+        """Per-kind replica-spec validation (reference
+        kubeflowjob_controller.go:182-196 plus the per-kind webhooks'
+        replica-type allowlists): roles must be unique, known to the
+        kind, and carry a positive replica count.  TAS annotations on
+        each replica are checked by the generic job webhook."""
+        errors: list[str] = []
+        seen: set[str] = set()
+        for r in self.replicas:
+            path = f"spec.{self.replica_specs_field}[{r.role}]"
+            if r.role in seen:
+                errors.append(f"{path}: duplicate replica type")
+            seen.add(r.role)
+            if self.role_order and r.role not in self.role_order:
+                errors.append(
+                    f"{path}: unsupported replica type for {self.kind}; "
+                    f"must be one of {list(self.role_order)}")
+            if r.replicas < 1:
+                errors.append(f"{path}.replicas: should be >= 1")
+        return errors
+
 
 class TFJob(KubeflowJob):
     kind = "TFJob"
     role_order = ("Master", "Chief", "PS", "Worker", "Evaluator")
+    replica_specs_field = "tfReplicaSpecs"
 
 
 class PyTorchJob(KubeflowJob):
     kind = "PyTorchJob"
     role_order = ("Master", "Worker")
+    replica_specs_field = "pytorchReplicaSpecs"
 
 
 class XGBoostJob(KubeflowJob):
     kind = "XGBoostJob"
     role_order = ("Master", "Worker")
+    replica_specs_field = "xgbReplicaSpecs"
 
 
 class PaddleJob(KubeflowJob):
     kind = "PaddleJob"
     role_order = ("Master", "Worker")
+    replica_specs_field = "paddleReplicaSpecs"
 
 
 class JAXJob(KubeflowJob):
     kind = "JAXJob"
     role_order = ("Worker",)
+    replica_specs_field = "jaxReplicaSpecs"
 
 
 class MPIJob(KubeflowJob):
     kind = "MPIJob"
     role_order = ("Launcher", "Worker")
+    replica_specs_field = "mpiReplicaSpecs"
 
 
 for _cls, _name in [(TFJob, "kubeflow.org/tfjob"),

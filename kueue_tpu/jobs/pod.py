@@ -13,6 +13,7 @@ from dataclasses import dataclass, field
 from typing import Optional, Sequence
 
 from ..api.types import PodSet, Workload
+from ..webhooks.validation import valid_dns1123_label
 from ..jobframework.interface import (
     ComposableJob,
     GenericJob,
@@ -26,6 +27,8 @@ SCHEDULING_GATE = "kueue.x-k8s.io/admission"
 GROUP_NAME_LABEL = "kueue.x-k8s.io/pod-group-name"
 GROUP_TOTAL_COUNT_ANNOTATION = "kueue.x-k8s.io/pod-group-total-count"
 ROLE_HASH_ANNOTATION = "kueue.x-k8s.io/role-hash"
+MANAGED_LABEL = "kueue.x-k8s.io/managed"
+RETRIABLE_IN_GROUP_ANNOTATION = "kueue.x-k8s.io/retriable-in-group"
 
 
 @dataclass
@@ -62,6 +65,86 @@ class Pod:
         return hashlib.sha256(repr(key).encode()).hexdigest()[:8]
 
 
+def default_pod(pod: Pod, queue: str = "") -> None:
+    """Pod webhook Default(): inject the scheduling gate, the managed
+    label, and — for group members — the role-hash annotation
+    (reference pod_webhook.go Default)."""
+    if pod.phase == "Pending" and SCHEDULING_GATE not in pod.scheduling_gates:
+        pod.scheduling_gates.append(SCHEDULING_GATE)
+    pod.labels.setdefault(MANAGED_LABEL, "true")
+    if queue:
+        pod.labels.setdefault("kueue.x-k8s.io/queue-name", queue)
+    if pod.labels.get(GROUP_NAME_LABEL):
+        pod.annotations.setdefault(ROLE_HASH_ANNOTATION, pod.role_hash)
+
+
+def validate_pod_create(pod: Pod) -> list[str]:
+    """Pod webhook ValidateCreate (reference pod_webhook.go:274-339):
+    managed-label value, group-metadata pairing, total-count syntax."""
+    errors: list[str] = []
+    managed = pod.labels.get(MANAGED_LABEL)
+    if managed is not None and managed != "true":
+        errors.append(
+            f"metadata.labels[{MANAGED_LABEL}]: "
+            "managed label value can only be 'true'")
+    group = pod.labels.get(GROUP_NAME_LABEL, "")
+    gtc = pod.annotations.get(GROUP_TOTAL_COUNT_ANNOTATION)
+    if not group:
+        if gtc is not None:
+            errors.append(
+                f"metadata.labels[{GROUP_NAME_LABEL}]: both the "
+                f"'{GROUP_TOTAL_COUNT_ANNOTATION}' annotation and the "
+                f"'{GROUP_NAME_LABEL}' label should be set")
+    else:
+        if not valid_dns1123_label(group):
+            errors.append(
+                f"metadata.labels[{GROUP_NAME_LABEL}]: {group!r} "
+                "must be a DNS-1123 label")
+        if gtc is None:
+            errors.append(
+                f"metadata.annotations[{GROUP_TOTAL_COUNT_ANNOTATION}]: "
+                f"both the '{GROUP_TOTAL_COUNT_ANNOTATION}' annotation and "
+                f"the '{GROUP_NAME_LABEL}' label should be set")
+        else:
+            try:
+                if int(gtc) < 1:
+                    errors.append(
+                        f"metadata.annotations"
+                        f"[{GROUP_TOTAL_COUNT_ANNOTATION}]: "
+                        "should be greater than or equal to 1")
+            except ValueError:
+                errors.append(
+                    f"metadata.annotations[{GROUP_TOTAL_COUNT_ANNOTATION}]: "
+                    f"{gtc!r} is not a valid integer")
+    retriable = pod.annotations.get(RETRIABLE_IN_GROUP_ANNOTATION)
+    if retriable is not None and retriable not in ("true", "false"):
+        errors.append(
+            f"metadata.annotations[{RETRIABLE_IN_GROUP_ANNOTATION}]: "
+            "value can only be 'true' or 'false'")
+    return errors
+
+
+def validate_pod_update(old: Pod, new: Pod) -> list[str]:
+    """Pod webhook ValidateUpdate — only the update-specific rules: the
+    one-way retriable-in-group transition (pod_webhook.go:341-348) and
+    group-name immutability.  Create rules run separately (the generic
+    job webhook re-applies them on every update)."""
+    errors: list[str] = []
+    if new.labels.get(GROUP_NAME_LABEL):
+        old_unretriable = old.annotations.get(
+            RETRIABLE_IN_GROUP_ANNOTATION) == "false"
+        new_unretriable = new.annotations.get(
+            RETRIABLE_IN_GROUP_ANNOTATION) == "false"
+        if old_unretriable and not new_unretriable:
+            errors.append(
+                f"metadata.annotations[{RETRIABLE_IN_GROUP_ANNOTATION}]: "
+                "unretriable pod group can't be converted to retriable")
+    if old.labels.get(GROUP_NAME_LABEL) != new.labels.get(GROUP_NAME_LABEL):
+        errors.append(
+            f"metadata.labels[{GROUP_NAME_LABEL}]: field is immutable")
+    return errors
+
+
 class PlainPod(GenericJob):
     """A single gated pod (reference pod integration, non-group mode)."""
 
@@ -70,6 +153,7 @@ class PlainPod(GenericJob):
     def __init__(self, pod: Pod, queue: str = ""):
         self.pod = pod
         self.queue = queue
+        default_pod(pod, queue)
 
     @property
     def name(self) -> str:
@@ -108,6 +192,12 @@ class PlainPod(GenericJob):
             return "Pod failed", False, True
         return "", False, False
 
+    def validate_on_create(self) -> list[str]:
+        return validate_pod_create(self.pod)
+
+    def validate_on_update(self, old: "PlainPod") -> list[str]:
+        return validate_pod_update(old.pod, self.pod)
+
     def is_active(self) -> bool:
         return self.pod.phase == "Running"
 
@@ -134,7 +224,7 @@ class PodGroup(GenericJob, ComposableJob):
     def add_pod(self, pod: Pod) -> None:
         pod.labels[GROUP_NAME_LABEL] = self.group_name
         pod.annotations[GROUP_TOTAL_COUNT_ANNOTATION] = str(self.total_count)
-        pod.annotations[ROLE_HASH_ANNOTATION] = pod.role_hash
+        default_pod(pod, self.queue)
         self.pods.append(pod)
 
     def list_members(self) -> list:
@@ -224,6 +314,28 @@ class PodGroup(GenericJob, ComposableJob):
     def pods_ready(self) -> bool:
         running = sum(1 for p in self.pods if p.phase == "Running")
         return running >= self.total_count
+
+    def validate_on_create(self) -> list[str]:
+        errors: list[str] = []
+        if self.total_count < 1:
+            errors.append("pod-group total count: should be >= 1")
+        if not valid_dns1123_label(self.group_name):
+            errors.append(
+                f"pod-group name: {self.group_name!r} must be a "
+                "DNS-1123 label")
+        for p in self.pods:
+            errors.extend(validate_pod_create(p))
+            declared = p.annotations.get(GROUP_TOTAL_COUNT_ANNOTATION)
+            if declared is not None and declared != str(self.total_count):
+                errors.append(
+                    f"pod {p.name}: group-total-count annotation "
+                    f"{declared!r} disagrees with the group size "
+                    f"{self.total_count}")
+        if len(self.pods) > self.total_count:
+            errors.append(
+                f"pod-group {self.group_name}: {len(self.pods)} member "
+                f"pods exceed the declared total count {self.total_count}")
+        return errors
 
 
 register_integration(IntegrationCallbacks(

@@ -34,6 +34,19 @@ def _valid_name(name: str) -> bool:
     return bool(name) and len(name) <= 253 and bool(_DNS1123.match(name))
 
 
+def valid_dns1123_label(name: str) -> bool:
+    """validation.IsDNS1123Label: <=63 chars, lowercase alphanumerics
+    and dashes, no dots."""
+    return bool(name) and len(name) <= 63 and bool(_DNS1123.match(name))
+
+
+def valid_dns1123_subdomain(name: str) -> bool:
+    """validation.IsDNS1123Subdomain: <=253 chars, dot-separated
+    DNS-1123 labels (each part capped at 63)."""
+    return bool(name) and len(name) <= 253 and all(
+        valid_dns1123_label(part) for part in name.split("."))
+
+
 # ---------------------------------------------------------------------------
 # Workload (workload_webhook.go)
 # ---------------------------------------------------------------------------

@@ -1,0 +1,263 @@
+"""Job-level webhook tests (reference pod_webhook_test.go patterns,
+jobframework/validation.go rules, kubeflow per-kind replica validation)
+plus the mixed-role pod-group admission lifecycle the round-3 verdict
+asked for."""
+
+import pytest
+
+from kueue_tpu.api.types import (
+    ClusterQueue,
+    FlavorQuotas,
+    LocalQueue,
+    PodSetTopologyRequest,
+    ResourceFlavor,
+    ResourceGroup,
+    ResourceQuota,
+)
+from kueue_tpu.controller.driver import Driver
+from kueue_tpu.jobframework import JobManager
+from kueue_tpu.jobframework.webhook import (
+    validate_job_create,
+    validate_job_update,
+)
+from kueue_tpu.jobs import BatchJob, PodGroup, PyTorchJob, ReplicaSpec, TFJob
+from kueue_tpu.jobs.pod import (
+    GROUP_NAME_LABEL,
+    GROUP_TOTAL_COUNT_ANNOTATION,
+    MANAGED_LABEL,
+    RETRIABLE_IN_GROUP_ANNOTATION,
+    ROLE_HASH_ANNOTATION,
+    SCHEDULING_GATE,
+    PlainPod,
+    Pod,
+    default_pod,
+    validate_pod_create,
+    validate_pod_update,
+)
+from kueue_tpu.webhooks.validation import ValidationError
+
+
+def make_driver(nominal=10_000, node_labels=None):
+    d = Driver()
+    d.apply_resource_flavor(ResourceFlavor(
+        name="default", node_labels=node_labels or {}))
+    d.apply_cluster_queue(ClusterQueue(
+        name="cq", resource_groups=[ResourceGroup(
+            covered_resources=["cpu"],
+            flavors=[FlavorQuotas(name="default", resources={
+                "cpu": ResourceQuota(nominal=nominal)})])]))
+    d.apply_local_queue(LocalQueue(name="lq", cluster_queue="cq"))
+    return d
+
+
+# -- mixed-role pod group lifecycle ------------------------------------
+
+
+def test_pod_group_mixed_roles_admission_lifecycle():
+    """A group with two distinct pod shapes becomes a two-podset gang
+    workload; admission ungates every member and injects the flavor's
+    node selector into each role."""
+    d = make_driver(node_labels={"cloud.com/type": "tpu-v5e"})
+    m = JobManager(d)
+    group = PodGroup("mixed", total_count=4, queue="lq")
+    for i in range(2):
+        group.add_pod(Pod(name=f"driver-{i}", requests={"cpu": 2000}))
+    for i in range(2):
+        group.add_pod(Pod(name=f"worker-{i}", requests={"cpu": 500},
+                          node_selector={"pool": "spot"}))
+    # two roles with the declared hashes
+    roles = group._roles()
+    assert len(roles) == 2
+    assert {len(pods) for _, pods in roles} == {2}
+    wl = group.construct_composable_workload()
+    assert sorted(ps.count for ps in wl.pod_sets) == [2, 2]
+    assert {ps.requests["cpu"] for ps in wl.pod_sets} == {2000, 500}
+
+    m.upsert(group)
+    m.run()
+    assert not group.is_suspended()
+    assert all(not p.gated for p in group.pods)
+    assert all(p.phase == "Running" for p in group.pods)
+    # flavor selector injected into every role; worker keeps its own
+    for p in group.pods:
+        assert p.node_selector["cloud.com/type"] == "tpu-v5e"
+    assert group.pods[2].node_selector["pool"] == "spot"
+    # usage covers both shapes: 2*2000 + 2*500
+    assert d.cache.usage("cq")[("default", "cpu")] == 5000
+
+    for p in group.pods:
+        p.phase = "Succeeded"
+    m.run()
+    assert all(v == 0 for v in d.cache.usage("cq").values())
+
+
+def test_pod_group_mixed_roles_role_hash_annotations():
+    group = PodGroup("hashed", total_count=2, queue="lq")
+    a = Pod(name="a", requests={"cpu": 100})
+    b = Pod(name="b", requests={"cpu": 200})
+    group.add_pod(a)
+    group.add_pod(b)
+    assert a.annotations[ROLE_HASH_ANNOTATION] != \
+        b.annotations[ROLE_HASH_ANNOTATION]
+    assert a.labels[GROUP_NAME_LABEL] == "hashed"
+    assert a.annotations[GROUP_TOTAL_COUNT_ANNOTATION] == "2"
+
+
+# -- pod webhook --------------------------------------------------------
+
+
+def test_default_pod_injects_gate_and_managed_label():
+    p = Pod(name="bare", scheduling_gates=[])
+    default_pod(p, queue="lq")
+    assert SCHEDULING_GATE in p.scheduling_gates
+    assert p.labels[MANAGED_LABEL] == "true"
+    assert p.labels["kueue.x-k8s.io/queue-name"] == "lq"
+    # group members get the role hash stamped
+    g = Pod(name="member", scheduling_gates=[],
+            labels={GROUP_NAME_LABEL: "g"})
+    default_pod(g)
+    assert g.annotations[ROLE_HASH_ANNOTATION] == g.role_hash
+
+
+def test_pod_managed_label_value_rejected():
+    p = Pod(name="p", labels={MANAGED_LABEL: "yes"})
+    errs = validate_pod_create(p)
+    assert any("managed label value" in e for e in errs)
+
+
+def test_pod_group_metadata_pairing():
+    # annotation without label
+    p = Pod(name="p", annotations={GROUP_TOTAL_COUNT_ANNOTATION: "3"})
+    assert any("should be set" in e for e in validate_pod_create(p))
+    # label without annotation
+    q = Pod(name="q", labels={GROUP_NAME_LABEL: "g"})
+    assert any("should be set" in e for e in validate_pod_create(q))
+    # malformed count
+    r = Pod(name="r", labels={GROUP_NAME_LABEL: "g"},
+            annotations={GROUP_TOTAL_COUNT_ANNOTATION: "three"})
+    assert any("not a valid integer" in e for e in validate_pod_create(r))
+    # well-formed passes
+    s = Pod(name="s", labels={GROUP_NAME_LABEL: "g"},
+            annotations={GROUP_TOTAL_COUNT_ANNOTATION: "3"})
+    assert validate_pod_create(s) == []
+
+
+def test_pod_unretriable_one_way():
+    old = Pod(name="p", labels={GROUP_NAME_LABEL: "g"},
+              annotations={GROUP_TOTAL_COUNT_ANNOTATION: "2",
+                           RETRIABLE_IN_GROUP_ANNOTATION: "false"})
+    new = Pod(name="p", labels={GROUP_NAME_LABEL: "g"},
+              annotations={GROUP_TOTAL_COUNT_ANNOTATION: "2"})
+    errs = validate_pod_update(old, new)
+    assert any("can't be converted to retriable" in e for e in errs)
+    # the other direction is allowed
+    assert validate_pod_update(new, old) == []
+
+
+def test_plain_pod_rejected_through_manager():
+    d = make_driver()
+    m = JobManager(d)
+    bad = PlainPod(Pod(name="bad", labels={MANAGED_LABEL: "nope"}),
+                   queue="lq")
+    with pytest.raises(ValidationError):
+        m.upsert(bad)
+    assert bad.key not in m.jobs
+
+
+def test_pod_group_size_validation_through_manager():
+    d = make_driver()
+    m = JobManager(d)
+    group = PodGroup("over", total_count=1, queue="lq")
+    group.add_pod(Pod(name="a", requests={"cpu": 100}))
+    group.add_pod(Pod(name="b", requests={"cpu": 100}))
+    with pytest.raises(ValidationError) as ei:
+        m.upsert(group)
+    assert "exceed the declared total count" in str(ei.value)
+
+
+# -- kubeflow per-kind validation ---------------------------------------
+
+
+def test_pytorchjob_unknown_replica_type_rejected():
+    d = make_driver()
+    m = JobManager(d)
+    job = PyTorchJob("bad", replicas=[
+        ReplicaSpec(role="Master", replicas=1, requests={"cpu": 100}),
+        ReplicaSpec(role="Chief", replicas=2, requests={"cpu": 100}),
+    ], queue="lq")
+    with pytest.raises(ValidationError) as ei:
+        m.upsert(job)
+    assert "unsupported replica type" in str(ei.value)
+
+
+def test_kubeflow_zero_replicas_rejected():
+    job = PyTorchJob("zero", replicas=[
+        ReplicaSpec(role="Worker", replicas=0, requests={"cpu": 100}),
+    ], queue="lq")
+    with pytest.raises(ValidationError) as ei:
+        validate_job_create(job)
+    assert "replicas: should be >= 1" in str(ei.value)
+
+
+def test_kubeflow_duplicate_replica_type_rejected():
+    job = TFJob("dup", replicas=[
+        ReplicaSpec(role="Worker", replicas=1, requests={"cpu": 100}),
+        ReplicaSpec(role="Worker", replicas=2, requests={"cpu": 100}),
+    ], queue="lq")
+    with pytest.raises(ValidationError) as ei:
+        validate_job_create(job)
+    assert "duplicate replica type" in str(ei.value)
+
+
+def test_tfjob_valid_replicas_admitted():
+    d = make_driver()
+    m = JobManager(d)
+    job = TFJob("good", replicas=[
+        ReplicaSpec(role="Chief", replicas=1, requests={"cpu": 100}),
+        ReplicaSpec(role="Worker", replicas=2, requests={"cpu": 100}),
+    ], queue="lq")
+    m.upsert(job)
+    m.run()
+    assert not job.is_suspended()
+    # Chief ordered before Worker (role_order)
+    assert [t.name for t in job.templates] == ["chief", "worker"]
+
+
+# -- generic job rules --------------------------------------------------
+
+
+def test_invalid_queue_name_rejected():
+    job = BatchJob("j", parallelism=1, requests={"cpu": 1},
+                   queue="Not_A_Queue")
+    with pytest.raises(ValidationError) as ei:
+        validate_job_create(job)
+    assert "DNS-1123" in str(ei.value)
+
+
+def test_conflicting_topology_annotations_rejected():
+    job = BatchJob("j", parallelism=1, requests={"cpu": 1}, queue="lq")
+    job.templates[0].topology_request = PodSetTopologyRequest(
+        required="cloud.com/rack", preferred="cloud.com/block")
+    with pytest.raises(ValidationError) as ei:
+        validate_job_create(job)
+    assert "more than one topology annotation" in str(ei.value)
+
+
+def test_queue_name_immutable_while_running():
+    d = make_driver()
+    m = JobManager(d)
+    job = BatchJob("run", parallelism=1, requests={"cpu": 100}, queue="lq")
+    m.upsert(job)
+    m.run()
+    assert not job.is_suspended()
+    moved = BatchJob("run", parallelism=1, requests={"cpu": 100},
+                     queue="other")
+    moved.suspended = False
+    with pytest.raises(ValidationError) as ei:
+        validate_job_update(job, moved)
+    assert "immutable while the job is not suspended" in str(ei.value)
+    # while suspended the move is allowed
+    job2 = BatchJob("mv", parallelism=1, requests={"cpu": 100}, queue="lq")
+    moved2 = BatchJob("mv", parallelism=1, requests={"cpu": 100},
+                      queue="other")
+    validate_job_update(job2, moved2)
