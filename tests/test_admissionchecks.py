@@ -276,3 +276,118 @@ def test_multikueue_job_level_dispatch():
     pump()
     assert job.succeeded == 2                     # status copied back
     assert manager.workloads[wl_key].is_finished
+
+
+# ---------------------------------------------------------------------------
+# Provisioning depth: PodTemplates, CapacityRevoked, BookingExpired
+# ---------------------------------------------------------------------------
+
+def test_provisioning_creates_pod_templates_with_flavor_selectors():
+    clock, driver, ctrl, _ = provisioning_setup()
+    driver.apply_resource_flavor(
+        ResourceFlavor(name="default",
+                       node_labels={"cloud.com/type": "tpu-v5e"}))
+    driver.create_workload(wl("templated"))
+    driver.run_until_settled()
+    ctrl.reconcile()
+    req = next(r for r in ctrl.requests.values()
+               if r.workload_key == "default/templated")
+    assert req.pod_sets[0]["pod_template_ref"] == f"ppt-{req.name}-main"
+    pt = ctrl.pod_templates[
+        f"default/{req.pod_sets[0]['pod_template_ref']}"]
+    assert pt.requests == {"cpu": 1000}
+    assert pt.count == 1
+    # the assigned flavor's node labels are merged into the template
+    assert pt.node_selector["cloud.com/type"] == "tpu-v5e"
+
+
+def test_provisioning_pod_templates_resynced_and_gcd():
+    clock, driver, ctrl, _ = provisioning_setup()
+    driver.create_workload(wl("resync"))
+    driver.run_until_settled()
+    ctrl.reconcile()
+    ref = next(iter(ctrl.pod_templates))
+    # template deleted out from under the live request → recreated
+    del ctrl.pod_templates[ref]
+    ctrl.reconcile()
+    assert ref in ctrl.pod_templates
+    # workload finishes → request and templates are GC'd
+    driver.finish_workload("default/resync")
+    ctrl.reconcile()
+    assert ctrl.pod_templates == {}
+    assert all(r.workload_key != "default/resync"
+               for r in ctrl.requests.values())
+
+
+def test_capacity_revoked_rejects_admitted_workload():
+    clock, driver, ctrl, _ = provisioning_setup()
+    driver.create_workload(wl("revoked"))
+    driver.run_until_settled()
+    ctrl.reconcile()
+    mwl = driver.workloads["default/revoked"]
+    assert mwl.is_admitted
+    req = next(r for r in ctrl.requests.values()
+               if r.workload_key == "default/revoked")
+    req.state = "CapacityRevoked"
+    req.failure_message = "nodes deleted"
+    ctrl.reconcile()
+    mwl = driver.workloads["default/revoked"]
+    # rejection evicts + deactivates (the driver resets check states on
+    # eviction, so deactivation is the observable outcome)
+    assert not mwl.is_active
+    assert not mwl.is_admitted
+
+
+def test_booking_expired_ignored_while_admitted():
+    clock, driver, ctrl, _ = provisioning_setup()
+    driver.create_workload(wl("booked"))
+    driver.run_until_settled()
+    ctrl.reconcile()
+    req = next(r for r in ctrl.requests.values()
+               if r.workload_key == "default/booked")
+    req.state = "BookingExpired"
+    ctrl.reconcile()
+    mwl = driver.workloads["default/booked"]
+    # an admitted workload keeps running through booking expiry
+    assert mwl.is_admitted
+    assert mwl.admission_check_states["prov"].state \
+        == AdmissionCheckState.READY
+
+
+def test_booking_expired_retries_before_admission():
+    clock, driver, ctrl, outcomes = provisioning_setup(
+        outcome="BookingExpired", limit=3)
+    driver.create_workload(wl("expired"))
+    driver.run_until_settled()
+    ctrl.reconcile()
+    # not admitted → booking expiry follows the retry path
+    assert ctrl.retry_state["default/expired"][0] == 2
+    outcomes["value"] = "Provisioned"
+    clock.tick(61.0)
+    driver.run_until_settled()
+    ctrl.reconcile()
+    mwl = driver.workloads["default/expired"]
+    assert mwl.admission_check_states["prov"].state \
+        == AdmissionCheckState.READY
+
+
+def test_keep_quota_gate_retries_without_eviction():
+    from kueue_tpu import features
+    clock, driver, ctrl, outcomes = provisioning_setup(outcome="Failed",
+                                                       limit=3)
+    with features.set_feature_gate_during_test(
+            "KeepQuotaForProvReqRetry", True):
+        driver.create_workload(wl("kept"))
+        driver.run_until_settled()
+        ctrl.reconcile()
+        mwl = driver.workloads["default/kept"]
+        # retry scheduled but the check stays Pending and quota is held
+        assert ctrl.retry_state["default/kept"][0] == 2
+        assert mwl.admission_check_states["prov"].state \
+            == AdmissionCheckState.PENDING
+        assert mwl.has_quota_reservation
+        outcomes["value"] = "Provisioned"
+        clock.tick(61.0)
+        ctrl.reconcile()
+        mwl = driver.workloads["default/kept"]
+        assert mwl.is_admitted
