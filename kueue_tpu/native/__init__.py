@@ -106,6 +106,29 @@ def classify_cycle(packed):
     return fit_slot, borrows.astype(bool), preempt.astype(bool)
 
 
+def admit_scan_raw(usage0, subtree_quota, guaranteed, borrow_cap,
+                   has_borrow_limit, parent, nominal_cq, npb_cq,
+                   wl_cq, dec_fr, dec_amt, fit_mask, res_fr, res_amt,
+                   res_mask, res_borrows, order):
+    """Array-level admit loop (same argument order as the jitted
+    ops/cycle.admit_scan) — lets the solver's warmup time the native
+    core with the same synthetic tensors it times the XLA backends on."""
+    lib = _load()
+    N, F = np.asarray(usage0).shape
+    C = np.asarray(nominal_cq).shape[0]
+    W, K = np.asarray(dec_fr).shape
+    admitted = np.empty(W, dtype=np.uint8)
+    lib.admit_scan(
+        N, F, C, K, W,
+        _i32(usage0), _i32(subtree_quota), _i32(guaranteed),
+        _i32(borrow_cap), _u8(has_borrow_limit), _i32(parent),
+        _i32(nominal_cq), _i32(npb_cq),
+        _i32(wl_cq), _i32(dec_fr), _i32(dec_amt), _u8(fit_mask),
+        _i32(res_fr), _i32(res_amt), _u8(res_mask), _u8(res_borrows),
+        _i32(order), admitted)
+    return admitted.astype(bool)
+
+
 def admit_scan(packed, dec_fr, dec_amt, fit_mask, res_fr, res_amt,
                res_mask, res_borrows, order):
     """The sequential admit loop in the compiled core — identical
@@ -114,21 +137,10 @@ def admit_scan(packed, dec_fr, dec_amt, fit_mask, res_fr, res_amt,
     Decision inputs are the (flavor-resource, amount) pair tensors the
     solver builds (CycleSolver._build_pair_tensors).  Returns
     admitted [W] bool in head order."""
-    lib = _load()
     st = packed.structure
-    N = packed.node_count
-    F = packed.usage0.shape[1]
-    C = len(packed.cq_names)
-    W, K = np.asarray(dec_fr).shape
-
-    admitted = np.empty(W, dtype=np.uint8)
-    lib.admit_scan(
-        N, F, C, K, W,
-        _i32(packed.usage0), _i32(packed.subtree_quota),
-        _i32(packed.guaranteed), _i32(packed.borrow_cap),
-        _u8(packed.has_borrow_limit), _i32(packed.parent),
-        _i32(packed.nominal_cq), _i32(st.nominal_plus_blimit_cq),
-        _i32(packed.wl_cq), _i32(dec_fr), _i32(dec_amt), _u8(fit_mask),
-        _i32(res_fr), _i32(res_amt), _u8(res_mask), _u8(res_borrows),
-        _i32(order), admitted)
-    return admitted.astype(bool)
+    return admit_scan_raw(
+        packed.usage0, packed.subtree_quota, packed.guaranteed,
+        packed.borrow_cap, packed.has_borrow_limit, packed.parent,
+        packed.nominal_cq, st.nominal_plus_blimit_cq,
+        packed.wl_cq, dec_fr, dec_amt, fit_mask, res_fr, res_amt,
+        res_mask, res_borrows, order)

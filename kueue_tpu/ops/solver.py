@@ -162,6 +162,7 @@ class CycleSolver:
             "accel_dispatches": 0,    # admit scan ran on the accelerator
             "cpu_dispatches": 0,      # admit scan ran on the XLA CPU backend
             "native_dispatches": 0,   # admit loop ran in the C++ core
+            "native_calibration_failures": 0,
             "skipped_dispatches": 0,  # no fit head -> scan provably no-op
             "singleton_dispatches": 0,  # <=1 entry/forest -> no contention
             "structure_rebuilds": 0,
@@ -299,6 +300,20 @@ class CycleSolver:
             if (self._accel_dev is not None
                     and self.backend in ("auto", "accel")):
                 devs.append(self._accel_dev)
+            # forest scan lengths for this bucket: 4 .. bucket(max CQs
+            # per forest); None when forest decomposition doesn't apply
+            mfw_ladder = None
+            if self._forests_apply(W, st.n_forests):
+                per_forest = np.bincount(
+                    st.forest_of_node[:len(st.cq_names)],
+                    minlength=st.n_forests)
+                top = _bucket(int(per_forest.max()), minimum=4)
+                mfw_ladder, mfw = [], 4
+                while True:
+                    mfw_ladder.append(mfw)
+                    if mfw >= top:
+                        break
+                    mfw *= 2
             for dev in devs:
                 # repeat dispatch+readback: the first executions through a
                 # tunneled accelerator are several times slower than
@@ -308,20 +323,14 @@ class CycleSolver:
                 name = "accel" if dev is self._accel_dev else "cpu"
                 reps = 3 if dev is self._accel_dev else 2
                 with jax.default_device(dev):
-                    if not self._forests_apply(W, st.n_forests):
+                    if mfw_ladder is None:
                         for _ in range(reps):
                             t0 = _time.perf_counter()
                             jax.device_get(admit_scan(*args, depth=st.depth))
                             dt = _time.perf_counter() - t0
                         self.calibration[(name, "flat", W, W)] = dt
                         continue
-                    # forest scan lengths: 4 .. bucket(max CQs per forest)
-                    C = len(st.cq_names)
-                    per_forest = np.bincount(st.forest_of_node[:C],
-                                             minlength=st.n_forests)
-                    top = _bucket(int(per_forest.max()), minimum=4)
-                    mfw = 4
-                    while True:
+                    for mfw in mfw_ladder:
                         for _ in range(reps):
                             t0 = _time.perf_counter()
                             jax.device_get(admit_scan_forests(
@@ -329,9 +338,39 @@ class CycleSolver:
                                 n_forests=st.n_forests, max_forest_wl=mfw))
                             dt = _time.perf_counter() - t0
                         self.calibration[(name, "forest", W, mfw)] = dt
-                        if mfw >= top:
-                            break
-                        mfw *= 2
+            # native core timing: the sequential C++ admit loop competes
+            # in the same calibration table, so the router picks the
+            # fastest of native / XLA-CPU / accel per bucket
+            if self.backend == "auto":
+                try:
+                    from .. import native
+                    if native.available():
+                        n_cq = len(st.cq_names)
+                        busy_cq = (np.arange(W)
+                                   % max(n_cq, 1)).astype(np.int32)
+                        busy_fr = np.full((W, R), -1, np.int32)
+                        busy_fr[:, 0] = np.arange(W) % F
+                        busy_amt = np.zeros((W, R), np.int32)
+                        busy_amt[:, 0] = 1
+                        for _ in range(2):
+                            t0 = _time.perf_counter()
+                            native.admit_scan_raw(
+                                *args[:8], busy_cq, busy_fr, busy_amt,
+                                np.ones(W, bool), args[12], args[13],
+                                np.zeros(W, bool), np.zeros(W, bool),
+                                args[16])
+                            dt = _time.perf_counter() - t0
+                        if mfw_ladder is None:
+                            self.calibration[("native", "flat", W, W)] = dt
+                        else:
+                            for mfw in mfw_ladder:
+                                self.calibration[
+                                    ("native", "forest", W, mfw)] = dt
+                except Exception:
+                    # routing falls back to the XLA backends; surfaced
+                    # so a broken native build can't hide (weak r3 #5)
+                    self.stats["native_calibration_failures"] += 1
+
             # first padded-K bucket (scalar heads with more decision
             # pairs than R, _build_pair_tensors): compile so a
             # multi-PodSet head can't stall a cycle on compilation
@@ -830,7 +869,19 @@ class CycleSolver:
                 else:
                     handle.pending = fns["flat"](*args, order)
             return handle
-        if self.backend == "native" and not has_preempt:
+        use_native = self.backend == "native"
+        if (not use_native and not has_preempt and self.backend == "auto"):
+            # calibrated three-way routing: the C++ admit loop competes
+            # with the XLA backends on measured time per bucket
+            key_len = mfw if mfw is not None else W
+            t_nat = self.calibration.get(("native", kernel, W, key_len))
+            if t_nat is not None:
+                others = [t for t in (
+                    self.calibration.get(("cpu", kernel, W, key_len)),
+                    self.calibration.get(("accel", kernel, W, key_len)))
+                    if t is not None]
+                use_native = not others or t_nat < min(others)
+        if use_native and not has_preempt:
             # the C++ core runs the admit loop synchronously (preempt
             # cycles keep the jitted scan — no native twin yet)
             from .. import native
