@@ -71,6 +71,11 @@ def build(scale: float):
                use_device_solver=os.environ.get("BENCH_DEVICE", "1") == "1")
     mesh_n = int(os.environ.get("BENCH_MESH", "0"))
     if mesh_n > 1:
+        # the axon TPU plugin ignores JAX_PLATFORMS from the environment;
+        # honour an explicit cpu request so the virtual mesh flags apply
+        if os.environ.get("JAX_PLATFORMS") == "cpu":
+            import jax
+            jax.config.update("jax_platforms", "cpu")
         if d.scheduler.solver is None:
             raise SystemExit("BENCH_MESH requires BENCH_DEVICE=1 "
                              "(the mesh shards the device solver)")
@@ -80,8 +85,15 @@ def build(scale: float):
         # variants compile on first use, so the first cycles of a mesh
         # run include jit compilation (mesh numbers are a scaling
         # artifact, not the headline benchmark).
-        from kueue_tpu.parallel import make_mesh
-        d.scheduler.solver.set_mesh(make_mesh(mesh_n))
+        from kueue_tpu.parallel import make_hybrid_mesh, make_mesh
+        hosts = int(os.environ.get("BENCH_MESH_HOSTS", "0"))
+        if hosts > 1:
+            # DCN-aware layout: cq axis within hosts, wl across them
+            import jax
+            d.scheduler.solver.set_mesh(make_hybrid_mesh(
+                n_hosts=hosts, devices=jax.devices()[:mesh_n]))
+        else:
+            d.scheduler.solver.set_mesh(make_mesh(mesh_n))
     d.apply_resource_flavor(ResourceFlavor(name="default"))
     total = 0
     waves: dict[str, list[Workload]] = {c[0]: [] for c in CLASSES}

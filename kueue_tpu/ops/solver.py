@@ -220,6 +220,75 @@ class CycleSolver:
             self._sharded_fns[depth] = fns
         return fns
 
+    @staticmethod
+    def _pad_rows(a, n_new, fill):
+        a = np.asarray(a)
+        if a.shape[0] == n_new:
+            return a
+        pad = np.full((n_new - a.shape[0],) + a.shape[1:], fill,
+                      dtype=a.dtype)
+        return np.concatenate([a, pad], axis=0)
+
+    def _mesh_pad(self, args, order, st, pmask=None, pre_fr=None,
+                  pre_amt=None, tgt_mat=None, forest_of_node=None):
+        """Pad the sharded axes to mesh-divisible sizes.
+
+        GSPMD requires dim0 of a tensor sharded over an axis to divide
+        the axis size; real clusters rarely oblige (e.g. 35 quota nodes
+        on a cq=2 mesh).  Padded nodes are inert (zero quota, parent -1,
+        never referenced by a head); padded heads are invalid
+        (wl_cq=-1, all masks false) and fetch slices decisions to the
+        real head count.  The structure-static tensors (args[1..7] and
+        forest_of_node) are padded once per (structure, mesh) and
+        cached on the structure; only the per-cycle tensors pay the
+        concatenate each dispatch."""
+        mesh_cq = self.mesh.shape["cq"]
+        mesh_wl = self.mesh.shape["wl"]
+
+        def up(n, m):
+            return -(-n // m) * m
+
+        N = args[0].shape[0]
+        C = args[6].shape[0]
+        W = args[8].shape[0]
+        Np, Cp, Wp = up(N, mesh_cq), up(C, mesh_cq), up(W, mesh_wl)
+        if (Np, Cp, Wp) == (N, C, W):
+            return (args, order, pmask, pre_fr, pre_amt, tgt_mat,
+                    forest_of_node)
+
+        rows = self._pad_rows
+        key = (mesh_wl, mesh_cq)
+        cached = getattr(st, "_mesh_pad_statics", None)
+        if cached is None or cached[0] != key:
+            statics = (
+                rows(args[1], Np, 0), rows(args[2], Np, 0),
+                rows(args[3], Np, 0), rows(args[4], Np, False),
+                rows(args[5], Np, -1),
+                rows(args[6], Cp, 0), rows(args[7], Cp, 0),
+                rows(st.forest_of_node, Np, 0))
+            st._mesh_pad_statics = cached = (key, statics)
+        statics = cached[1]
+        args = (
+            (rows(args[0], Np, 0),) + statics[:7]
+            + (rows(args[8], Wp, -1), rows(args[9], Wp, -1),
+               rows(args[10], Wp, 0), rows(args[11], Wp, False),
+               rows(args[12], Wp, -1), rows(args[13], Wp, 0),
+               rows(args[14], Wp, False), rows(args[15], Wp, False)))
+        order = np.concatenate(
+            [np.asarray(order),
+             np.arange(W, Wp, dtype=np.asarray(order).dtype)])
+        if pmask is not None:
+            pmask = rows(pmask, Wp, False)
+        if pre_fr is not None:
+            pre_fr = rows(pre_fr, Wp, -1)
+        if pre_amt is not None:
+            pre_amt = rows(pre_amt, Wp, 0)
+        if tgt_mat is not None:
+            tgt_mat = rows(tgt_mat, Wp, -1)
+        if forest_of_node is not None:
+            forest_of_node = statics[7]
+        return args, order, pmask, pre_fr, pre_amt, tgt_mat, forest_of_node
+
     def _pick_device(self, n_heads: int):
         self._resolve_devices()
         if self.backend in ("cpu", "native"):
@@ -857,29 +926,48 @@ class CycleSolver:
             handle.route = "sharded"
             with annotation(f"admit_scan_sharded:{kernel}"):
                 if has_preempt:
+                    (pargs, porder, ppmask, ppre_fr, ppre_amt, ptgt,
+                     _) = self._mesh_pad(
+                        args, order, st, pmask=pmask, pre_fr=pre_fr,
+                        pre_amt=pre_amt, tgt_mat=targets.tgt_mat)
                     self.stats["sharded_preempt_dispatches"] += 1
                     handle.pending = fns["preempt"](
-                        *args, pmask, pre_fr, pre_amt,
-                        targets.tgt_mat, targets.tu_cq, targets.tu_delta,
-                        order)
+                        *pargs, ppmask, ppre_fr, ppre_amt,
+                        ptgt, targets.tu_cq, targets.tu_delta,
+                        porder)
                 elif mfw is not None:
+                    pargs, porder, _, _, _, _, pforest = self._mesh_pad(
+                        args, order, st, forest_of_node=st.forest_of_node)
                     handle.pending = fns["forest"](
-                        *args, order, forest_of_node=st.forest_of_node,
+                        *pargs, porder, forest_of_node=pforest,
                         n_forests=st.n_forests, max_forest_wl=mfw)
                 else:
-                    handle.pending = fns["flat"](*args, order)
+                    pargs, porder, _, _, _, _, _ = self._mesh_pad(
+                        args, order, st)
+                    handle.pending = fns["flat"](*pargs, porder)
             return handle
         use_native = self.backend == "native"
         if (not use_native and not has_preempt and self.backend == "auto"):
             # calibrated three-way routing: the C++ admit loop competes
-            # with the XLA backends on measured time per bucket
+            # with the XLA backends on measured time per bucket.  The
+            # native time is mfw-independent (one sequential loop), so a
+            # forest bucket beyond the warmup ladder falls back to any
+            # recorded forest entry at this W — same for the XLA twins,
+            # whose ladder has the same cap.
             key_len = mfw if mfw is not None else W
-            t_nat = self.calibration.get(("native", kernel, W, key_len))
+
+            def _lookup(name):
+                t = self.calibration.get((name, kernel, W, key_len))
+                if t is None and kernel == "forest":
+                    t = max((v for k, v in self.calibration.items()
+                             if k[:3] == (name, "forest", W)),
+                            default=None)
+                return t
+
+            t_nat = _lookup("native")
             if t_nat is not None:
-                others = [t for t in (
-                    self.calibration.get(("cpu", kernel, W, key_len)),
-                    self.calibration.get(("accel", kernel, W, key_len)))
-                    if t is not None]
+                others = [t for t in (_lookup("cpu"), _lookup("accel"))
+                          if t is not None]
                 use_native = not others or t_nat < min(others)
         if use_native and not has_preempt:
             # the C++ core runs the admit loop synchronously (preempt

@@ -9,9 +9,14 @@ The admission cycle is SPMD over two axes (SURVEY §2.5, §7):
   gather collectives where a workload reads a remote CQ's availability.
 
 There is no NCCL/MPI here by design: collectives are XLA's, riding ICI
-(reference equivalent: the API-server watch fabric, SURVEY §5.8).
+within a host; across hosts, :func:`make_hybrid_mesh` lays the mesh out
+so only the once-per-cycle ``wl`` gather crosses DCN while the per-step
+``cq`` collectives stay on ICI (reference equivalent: the API-server
+watch fabric, SURVEY §5.8).
 """
 
-from .sharded import cycle_args, make_mesh, sharded_cycle_fn
+from .sharded import (cycle_args, make_hybrid_mesh, make_mesh,
+                      sharded_cycle_fn)
 
-__all__ = ["cycle_args", "make_mesh", "sharded_cycle_fn"]
+__all__ = ["cycle_args", "make_hybrid_mesh", "make_mesh",
+           "sharded_cycle_fn"]

@@ -128,3 +128,49 @@ def admit_scan_fns(mesh: Mesh, depth: int):
         in_shardings=base + (wl, wl, wl, wl, rep, rep, wl))
 
     return {"flat": flat, "forest": forests, "preempt": preempt}
+
+
+# ---------------------------------------------------------------------------
+# Multi-host (DCN) mesh layout
+# ---------------------------------------------------------------------------
+
+def make_hybrid_mesh(n_hosts: int | None = None, devices=None) -> Mesh:
+    """A two-tier (wl, cq) mesh laid out so collective traffic matches
+    the interconnect hierarchy (the DCN story for SURVEY §5.8; reference
+    analog: MultiKueue spreading managers across clusters).
+
+    The admit scan's carried usage tensor triggers per-step collectives
+    on the ``cq`` axis, so that axis is pinned WITHIN a host — its
+    reduce/gather traffic rides ICI.  The ``wl`` axis needs one
+    all-gather per cycle (head slices back to the scan), so it is the
+    axis that spans hosts over DCN: slow-link traffic is paid once per
+    cycle, not once per scan step.  This mirrors the scaling-book recipe
+    of mapping the highest-frequency collective to the fastest axis.
+
+    On a real multi-host platform hosts are discovered from
+    ``device.process_index``; ``n_hosts`` partitions a single-process
+    (or virtual CPU) device list into equal groups for testing the
+    layout without multi-host hardware.
+    """
+    if devices is None:
+        devices = jax.devices()
+    n = len(devices)
+    if n_hosts is None:
+        by_host: dict[int, list] = {}
+        for d in devices:
+            by_host.setdefault(getattr(d, "process_index", 0), []).append(d)
+        groups = [by_host[k] for k in sorted(by_host)]
+    else:
+        if n % n_hosts:
+            raise ValueError(f"{n} devices do not split into {n_hosts} hosts")
+        per = n // n_hosts
+        groups = [list(devices[i * per:(i + 1) * per])
+                  for i in range(n_hosts)]
+    local = len(groups[0])
+    if any(len(g) != local for g in groups):
+        raise ValueError("hosts expose unequal device counts")
+    # cq axis = one whole host (the quota plane and its per-step
+    # collectives live entirely on that host's ICI); wl axis = hosts
+    dev_array = np.asarray(
+        [np.asarray(g) for g in groups])          # [hosts, local]
+    return Mesh(dev_array, axis_names=("wl", "cq"))

@@ -45,3 +45,37 @@ def test_sharded_cycle_matches_single_device(packed):
 def test_dryrun_multichip_entrypoint():
     import __graft_entry__ as ge
     ge.dryrun_multichip(8)
+
+
+def test_hybrid_mesh_layout_keeps_cq_within_host():
+    """make_hybrid_mesh: the cq axis (per-scan-step collectives) never
+    crosses a host boundary — each mesh row is exactly one host's
+    devices, so DCN only carries the once-per-cycle wl gather."""
+    from kueue_tpu.parallel import make_hybrid_mesh
+    devices = jax.devices()
+    mesh = make_hybrid_mesh(n_hosts=4, devices=devices)
+    assert dict(mesh.shape) == {"wl": 4, "cq": 2}
+    arr = np.asarray(mesh.devices)
+    for host in range(4):
+        row_ids = {d.id for d in arr[host]}
+        expect = {devices[host * 2].id, devices[host * 2 + 1].id}
+        assert row_ids == expect, (host, row_ids)
+    # real-platform path: process_index grouping (single process on the
+    # test box -> one host spanning everything on the cq axis)
+    auto = make_hybrid_mesh(devices=devices)
+    assert dict(auto.shape) == {"wl": 1, "cq": 8}
+    with pytest.raises(ValueError):
+        make_hybrid_mesh(n_hosts=3, devices=devices)
+
+
+def test_hybrid_mesh_cycle_matches_single_device(packed):
+    """Decisions are topology-independent on the DCN-aware layout too."""
+    from kueue_tpu.parallel import make_hybrid_mesh
+    args = cycle_args(packed)
+    ref = [np.asarray(o) for o in solve_cycle(*args, depth=packed.depth)]
+    mesh = make_hybrid_mesh(n_hosts=4)
+    fn = sharded_cycle_fn(mesh, depth=packed.depth)
+    out = [np.asarray(jax.device_get(o)) for o in fn(*args)]
+    for i, (a, b) in enumerate(zip(ref, out)):
+        np.testing.assert_array_equal(a, b, err_msg=f"output {i} diverged")
+    assert out[0].any()

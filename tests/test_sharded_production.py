@@ -97,3 +97,48 @@ def test_mesh_routed_production_cycles_match_unmeshed():
     assert stats.get("sharded_dispatches", 0) > 0, stats
     assert stats.get("sharded_preempt_dispatches", 0) > 0, stats
     assert dm.admitted_keys() == du.admitted_keys()
+
+
+def test_mesh_pad_non_divisible_nodes_and_hybrid_layout():
+    """Real clusters rarely expose mesh-divisible shapes (the bench's 35
+    quota nodes on a cq=2 axis crashed pjit before _mesh_pad).  An extra
+    lone CQ makes the node count odd; decisions must still match the
+    unmeshed solver exactly — on the DCN-aware hybrid layout too."""
+    from kueue_tpu.parallel import make_hybrid_mesh
+    mesh = make_hybrid_mesh(n_hosts=4)
+    assert dict(mesh.shape) == {"wl": 4, "cq": 2}
+
+    def extra(d):
+        d.apply_cluster_queue(ClusterQueue(
+            name="lone", resource_groups=[ResourceGroup(
+                covered_resources=["cpu"],
+                flavors=[FlavorQuotas(name="default", resources={
+                    "cpu": ResourceQuota(nominal=3000)})])]))
+        d.apply_local_queue(LocalQueue(name="lq-lone",
+                                       cluster_queue="lone"))
+        for i in range(3):
+            d.create_workload(Workload(
+                name=f"lone-{i}", queue_name="lq-lone",
+                creation_time=900.0 + i,
+                pod_sets=[PodSet(name="main", count=1,
+                                 requests={"cpu": 1500})]))
+
+    dm, cm = build(mesh)
+    du, cu = build(None)
+    extra(dm)
+    extra(du)
+    # node count is now odd (8 CQs + 4 cohorts + 1 lone CQ = 13)
+    for cyc in range(6):
+        if cyc == 2:
+            wave(dm)
+            wave(du)
+        cm.t += 1.0
+        cu.t += 1.0
+        sm = dm.schedule_once()
+        su = du.schedule_once()
+        assert sm.admitted == su.admitted, cyc
+        assert sorted(sm.preempted_targets) == sorted(su.preempted_targets)
+        assert sorted(sm.skipped) == sorted(su.skipped)
+    stats = dm.scheduler.solver.stats
+    assert stats.get("sharded_dispatches", 0) > 0, stats
+    assert dm.admitted_keys() == du.admitted_keys()
