@@ -1,0 +1,81 @@
+"""Accelerator-route smoke test (round-3 weak #4: every suite pinned
+JAX to CPU, so the one backend the project is named for was
+test-uncovered).
+
+The test process itself is pinned to the virtual CPU mesh by conftest,
+so the accelerator run happens in a subprocess with a clean JAX.  The
+subprocess solves a packed cycle ON the accelerator and checks the
+decisions against the scalar host oracle; infrastructure problems (no
+chip, tunnel down, slow compile) skip rather than fail — only a
+decision divergence on a working chip is a failure.
+"""
+
+import json
+import os
+import subprocess
+import sys
+
+import pytest
+
+_SUBPROCESS = r'''
+import json
+import sys
+
+import numpy as np
+import jax
+
+accel = [d for d in jax.devices() if d.platform != "cpu"]
+if not accel:
+    print(json.dumps({"skip": "no accelerator platform"}))
+    sys.exit(0)
+
+import __graft_entry__ as ge
+from kueue_tpu.ops.cycle import classify_np, solve_cycle
+from kueue_tpu.parallel import cycle_args
+
+_, _, _, packed = ge._packed_cycle(n_cohorts=4, cqs_per_cohort=4,
+                                   n_workloads=64, contended=True)
+ref = classify_np(packed)                      # scalar host oracle
+with jax.default_device(accel[0]):
+    out = solve_cycle(*cycle_args(packed), depth=packed.depth,
+                      run_scan=False)
+    fit_slot0, borrows0 = [np.asarray(jax.device_get(o))
+                           for o in (out[4], out[5])]
+    dev = out[4].devices() if hasattr(out[4], "devices") else set()
+ok = (np.array_equal(fit_slot0, ref["fit_slot0"])
+      and np.array_equal(borrows0, ref["borrows0"]))
+print(json.dumps({
+    "platform": accel[0].platform,
+    "on_accel": all(d.platform != "cpu" for d in dev) if dev else None,
+    "decisions_match": bool(ok),
+    "heads": int(packed.wl_count),
+}))
+sys.exit(0 if ok else 1)
+'''
+
+
+def test_accel_solve_matches_host_oracle():
+    env = {k: v for k, v in os.environ.items()
+           if k not in ("JAX_PLATFORMS", "XLA_FLAGS")}
+    try:
+        proc = subprocess.run(
+            [sys.executable, "-c", _SUBPROCESS],
+            capture_output=True, text=True, timeout=240,
+            cwd=os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+            env=env)
+    except subprocess.TimeoutExpired:
+        pytest.skip("accelerator compile/dispatch exceeded 240s "
+                    "(tunnel slow or down)")
+    lines = [l for l in proc.stdout.strip().splitlines()
+             if l.startswith("{")]
+    if not lines:
+        pytest.skip(f"accelerator subprocess produced no result "
+                    f"(rc={proc.returncode}): {proc.stderr[-500:]}")
+    result = json.loads(lines[-1])
+    if "skip" in result:
+        pytest.skip(result["skip"])
+    assert result["decisions_match"], result
+    # the placement must actually have landed on the accelerator —
+    # jax.default_device is a hint, so check the output's device set
+    if result["on_accel"] is not None:
+        assert result["on_accel"], result

@@ -26,17 +26,7 @@ from kueue_tpu.admissionchecks import (
     WorkerCluster,
 )
 from kueue_tpu.controller.driver import Driver
-
-
-class FakeClock:
-    def __init__(self, now=1000.0):
-        self.t = now
-
-    def __call__(self):
-        return self.t
-
-    def tick(self, dt=1.0):
-        self.t += dt
+from tests.conftest import FakeClock
 
 
 def make_cluster(clock, nominal=5000, checks=()):

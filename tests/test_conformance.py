@@ -31,14 +31,7 @@ from kueue_tpu.api.types import (
 )
 from kueue_tpu.controller.driver import Driver
 from kueue_tpu.workload import set_quota_reservation, sync_admitted_condition
-
-
-class FakeClock:
-    def __init__(self, now=1000.0):
-        self.t = now
-
-    def __call__(self):
-        return self.t
+from tests.conftest import FakeClock
 
 
 NAMESPACES = {

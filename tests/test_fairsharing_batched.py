@@ -25,14 +25,7 @@ from kueue_tpu.api.types import (
     Workload,
 )
 from kueue_tpu.controller.driver import Driver
-
-
-class FakeClock:
-    def __init__(self, now=1000.0):
-        self.t = now
-
-    def __call__(self):
-        return self.t
+from tests.conftest import FakeClock
 
 
 def build_fs_driver(seed, *, batched, use_device=False, n_cohorts=2,

@@ -27,14 +27,7 @@ from kueue_tpu.jobs import (
 )
 from kueue_tpu.jobs.pod import Pod
 from kueue_tpu.jobs.ray import WorkerGroupSpec
-
-
-class FakeClock:
-    def __init__(self, now=1000.0):
-        self.t = now
-
-    def __call__(self):
-        return self.t
+from tests.conftest import FakeClock
 
 
 def make_driver(nominal=10_000, node_labels=None):

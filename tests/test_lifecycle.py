@@ -21,17 +21,7 @@ from kueue_tpu.api.types import (
     WL_EVICTED,
 )
 from kueue_tpu.controller.driver import Driver, WaitForPodsReadyConfig
-
-
-class FakeClock:
-    def __init__(self, now=1000.0):
-        self.t = now
-
-    def __call__(self):
-        return self.t
-
-    def tick(self, dt=1.0):
-        self.t += dt
+from tests.conftest import FakeClock
 
 
 def simple_cq(name, cohort=None, nominal=10_000, stop=StopPolicy.NONE,

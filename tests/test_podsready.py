@@ -22,14 +22,7 @@ from kueue_tpu.api.types import (
 from kueue_tpu.controller.driver import Driver, WaitForPodsReadyConfig
 from kueue_tpu.jobframework.reconciler import JobManager
 from kueue_tpu.jobs.batch_job import BatchJob
-
-
-class FakeClock:
-    def __init__(self, now=1000.0):
-        self.t = now
-
-    def __call__(self):
-        return self.t
+from tests.conftest import FakeClock
 
 
 class SlowStartJob(BatchJob):

@@ -22,14 +22,7 @@ from kueue_tpu.api.types import (
     Workload,
 )
 from kueue_tpu.controller.driver import Driver
-
-
-class FakeClock:
-    def __init__(self, now=1000.0):
-        self.t = now
-
-    def __call__(self):
-        return self.t
+from tests.conftest import FakeClock
 
 
 def build_preemption_driver(seed, device_search, n_cqs=4, n_low=10):

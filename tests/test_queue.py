@@ -13,14 +13,7 @@ from kueue_tpu.api.types import (
 )
 from kueue_tpu.queue import Manager, RequeueReason
 from kueue_tpu.workload import Info
-
-
-class FakeClock:
-    def __init__(self, now=1000.0):
-        self.t = now
-
-    def __call__(self):
-        return self.t
+from tests.conftest import FakeClock
 
 
 def make_wl(name, queue="lq", priority=0, created=0.0):

@@ -23,14 +23,7 @@ from kueue_tpu.cache import resource_node as rn
 from kueue_tpu.controller.driver import Driver
 from kueue_tpu.ops.packing import pack_cycle
 from kueue_tpu.resources import FlavorResource
-
-
-class FakeClock:
-    def __init__(self, now=1000.0):
-        self.t = now
-
-    def __call__(self):
-        return self.t
+from tests.conftest import FakeClock
 
 
 def random_cluster(rng, n_cohorts=3, n_cqs=6, n_flavors=2, nested=False):

@@ -24,14 +24,7 @@ from kueue_tpu.api.types import (
 )
 from kueue_tpu.cache.tas_cache import NodeInfo
 from kueue_tpu.controller.driver import Driver
-
-
-class FakeClock:
-    def __init__(self, now=1000.0):
-        self.t = now
-
-    def __call__(self):
-        return self.t
+from tests.conftest import FakeClock
 
 
 @pytest.fixture
