@@ -63,6 +63,24 @@ class JobSet(TemplateJob, JobWithReclaimablePods):
     def reclaimable_pods(self) -> dict[str, int]:
         return {n: c for n, c in self.succeeded.items() if c > 0}
 
+    def validate_on_create(self) -> list[str]:
+        """jobset_webhook.go rules: replicated-job names must be unique
+        and each must request at least one pod."""
+        errors = []
+        seen: set[str] = set()
+        for i, rj in enumerate(self.replicated_jobs):
+            path = f"spec.replicatedJobs[{i}]"
+            if rj.name in seen:
+                errors.append(f"{path}.name: duplicate replicated job "
+                              f"{rj.name!r}")
+            seen.add(rj.name)
+            if rj.replicas < 1:
+                errors.append(f"{path}.replicas: should be >= 1")
+            if rj.parallelism < 1:
+                errors.append(
+                    f"{path}.template.spec.parallelism: should be >= 1")
+        return errors
+
 
 register_integration(IntegrationCallbacks(
     name="jobset.x-k8s.io/jobset", gvk=JobSet.kind, new_job=JobSet))

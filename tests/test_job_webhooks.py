@@ -261,3 +261,70 @@ def test_queue_name_immutable_while_running():
     moved2 = BatchJob("mv", parallelism=1, requests={"cpu": 100},
                       queue="other")
     validate_job_update(job2, moved2)
+
+
+# -- ray / jobset webhook rules -----------------------------------------
+
+
+def test_rayjob_webhook_rules():
+    from kueue_tpu.jobs.ray import RayJob, WorkerGroupSpec
+    bad = RayJob("r", head_requests={"cpu": 100},
+                 worker_groups=[WorkerGroupSpec(name="head")],
+                 shutdown_after_job_finishes=False,
+                 cluster_selector={"ray.io/cluster": "existing"},
+                 enable_in_tree_autoscaling=True, queue="lq")
+    errs = bad.validate_on_create()
+    assert any("shutdownAfterJobFinishes" in e for e in errs)
+    assert any("clusterSelector" in e for e in errs)
+    assert any("enableInTreeAutoscaling" in e for e in errs)
+    assert any("reserved for the head group" in e for e in errs)
+    # the submitter pod set consumes a slot: 7 groups fit in HTTPMode
+    # but not in K8sJobMode, and its name is reserved there
+    seven = [WorkerGroupSpec(name=f"g{i}") for i in range(7)]
+    k8s = RayJob("m", head_requests={"cpu": 100}, worker_groups=seven,
+                 queue="lq")
+    assert any("too many worker groups" in e
+               for e in k8s.validate_on_create())
+    http = RayJob("m2", head_requests={"cpu": 100}, worker_groups=seven,
+                  submission_mode="HTTPMode", queue="lq")
+    assert not any("too many" in e for e in http.validate_on_create())
+    sub = RayJob("m3", head_requests={"cpu": 100},
+                 worker_groups=[WorkerGroupSpec(name="submitter")],
+                 queue="lq")
+    assert any("reserved for the submitter pod" in e
+               for e in sub.validate_on_create())
+    dup = RayJob("m4", head_requests={"cpu": 100},
+                 worker_groups=[WorkerGroupSpec(name="g"),
+                                WorkerGroupSpec(name="g")], queue="lq")
+    assert any("duplicate group name" in e
+               for e in dup.validate_on_create())
+
+
+def test_rayjob_numofhosts_and_submitter_podsets():
+    """Multi-host TPU worker groups: count = replicas x numOfHosts
+    (rayjob_controller.go:141-142); K8sJobMode adds a submitter pod."""
+    from kueue_tpu.jobs.ray import RayJob, WorkerGroupSpec
+    rj = RayJob("tpu", head_requests={"cpu": 1000},
+                worker_groups=[WorkerGroupSpec(
+                    name="v5e-group", replicas=2, num_of_hosts=4,
+                    requests={"cpu": 8000})],
+                queue="lq")
+    by_name = {ps.name: ps for ps in rj.pod_sets()}
+    assert by_name["v5e-group"].count == 8
+    assert by_name["head"].count == 1
+    assert by_name["submitter"].count == 1
+    http = RayJob("http", head_requests={"cpu": 1000},
+                  worker_groups=[], submission_mode="HTTPMode", queue="lq")
+    assert [ps.name for ps in http.pod_sets()] == ["head"]
+
+
+def test_jobset_webhook_rules():
+    from kueue_tpu.jobs import JobSet, ReplicatedJobSpec
+    bad = JobSet("js", replicated_jobs=[
+        ReplicatedJobSpec(name="workers", replicas=0, parallelism=1),
+        ReplicatedJobSpec(name="workers", replicas=1, parallelism=0),
+    ], queue="lq")
+    errs = bad.validate_on_create()
+    assert any("duplicate replicated job" in e for e in errs)
+    assert any("replicas: should be >= 1" in e for e in errs)
+    assert any("parallelism: should be >= 1" in e for e in errs)
