@@ -9,7 +9,11 @@ RayCluster is a long-running service that only finishes on deletion.
 Webhook rules follow rayjob_webhook.go:100-143: shutdownAfterJobFinishes
 must be set, no pre-existing cluster, no in-tree autoscaling, at most 7
 worker groups (8 pod sets with the head), and "head" is a reserved
-group name.
+group name.  One deliberate tightening: in K8sJobMode the submitter pod
+set also consumes a slot, so the cap drops to 6 — the reference webhook
+allows 7 there (rayjob_webhook.go:123 ignores submission mode) and then
+rejects the 9-pod-set Workload at the workload webhook instead; we fail
+at job admission where the user can see it.
 """
 
 from __future__ import annotations
@@ -23,6 +27,7 @@ from .base import PodTemplate, TemplateJob
 HEAD_GROUP = "head"
 SUBMITTER = "submitter"
 MAX_WORKER_GROUPS = 7          # 8 pod sets minus the head
+SUBMISSION_MODES = ("K8sJobMode", "HTTPMode", "InteractiveMode")
 
 
 @dataclass
@@ -93,9 +98,12 @@ class RayJob(TemplateJob):
         if submission_mode == "K8sJobMode":
             # the job-submission pod competes for quota too
             # (rayjob_controller.go:155-168)
+            # reference default submitter shape: 500m cpu + 200Mi memory
+            # (rayjob_controller.go getSubmitterTemplate)
             templates.append(PodTemplate(
                 name=SUBMITTER, count=1,
-                requests=dict(submitter_requests or {"cpu": 500})))
+                requests=dict(submitter_requests
+                              or {"cpu": 500, "memory": 200})))
         super().__init__(name, templates=templates, **kw)
         self.worker_groups = list(worker_groups)
         self.submission_mode = submission_mode
@@ -116,6 +124,10 @@ class RayJob(TemplateJob):
 
     def validate_on_create(self) -> list[str]:
         errors = []
+        if self.submission_mode not in SUBMISSION_MODES:
+            errors.append(
+                f"spec.submissionMode: {self.submission_mode!r} is not "
+                f"one of {list(SUBMISSION_MODES)}")
         if not self.shutdown_after_job_finishes:
             errors.append(
                 "spec.shutdownAfterJobFinishes: a kueue managed job "

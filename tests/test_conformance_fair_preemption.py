@@ -1,0 +1,269 @@
+"""Conformance replay of the reference's TestFairPreemptions tables
+(/root/reference/pkg/scheduler/preemption/preemption_test.go:1891-2200),
+end to end through the fair-sharing scheduler on both paths.
+
+Fixture: CQs a/b/c (nominal 3 cpu each) + preemptible (nominal 0) in one
+cohort "all" (total 9), borrowWithinCohort LowerPriority threshold -3,
+withinClusterQueue LowerPriority, reclaimWithinCohort Any — the `want`
+sets are the reference's own expectations, transliterated."""
+
+import pytest
+
+from kueue_tpu.api.types import (
+    BorrowWithinCohort,
+    BorrowWithinCohortPolicy,
+    ClusterQueue,
+    FlavorQuotas,
+    LocalQueue,
+    PreemptionPolicy,
+    ReclaimWithinCohort,
+    ResourceFlavor,
+    ResourceGroup,
+    ResourceQuota,
+    WithinClusterQueue,
+)
+from kueue_tpu.controller.driver import Driver
+from tests.conftest import FakeClock
+from tests.test_conformance_preemption import admit, cycle, incoming, preempted
+
+K = 1000
+
+
+def make_driver(use_device):
+    clock = FakeClock()
+    d = Driver(clock=clock, use_device_solver=use_device, fair_sharing=True,
+               solver_backend="cpu" if use_device else "auto")
+    d.apply_resource_flavor(ResourceFlavor(name="default"))
+    policy = PreemptionPolicy(
+        within_cluster_queue=WithinClusterQueue.LOWER_PRIORITY,
+        reclaim_within_cohort=ReclaimWithinCohort.ANY,
+        borrow_within_cohort=BorrowWithinCohort(
+            policy=BorrowWithinCohortPolicy.LOWER_PRIORITY,
+            max_priority_threshold=-3))
+    for name in ("a", "b", "c"):
+        d.apply_cluster_queue(ClusterQueue(
+            name=name, cohort="all", preemption=policy,
+            resource_groups=[ResourceGroup(covered_resources=["cpu"], flavors=[
+                FlavorQuotas(name="default", resources={
+                    "cpu": ResourceQuota(nominal=3 * K)})])]))
+        d.apply_local_queue(LocalQueue(name=f"lq-{name}", cluster_queue=name))
+    d.apply_cluster_queue(ClusterQueue(
+        name="preemptible", cohort="all",
+        resource_groups=[ResourceGroup(covered_resources=["cpu"], flavors=[
+            FlavorQuotas(name="default", resources={
+                "cpu": ResourceQuota(nominal=0)})])]))
+    d.apply_local_queue(LocalQueue(name="lq-preemptible",
+                                   cluster_queue="preemptible"))
+    return d, clock
+
+
+def units(d, cq_name, names, cpu=1 * K, priority=0):
+    for n in names:
+        admit(d, n, cq_name, {"cpu": ("default", cpu)}, priority=priority)
+
+
+@pytest.fixture(params=[False, True], ids=["host", "device"])
+def use_device(request):
+    return request.param
+
+
+# --- :1952 "reclaim nominal from user using the most" -------------------
+
+def test_reclaim_nominal_from_biggest_user(use_device):
+    d, clock = make_driver(use_device)
+    units(d, "a", ["a1", "a2", "a3"])
+    units(d, "b", ["b1", "b2", "b3", "b4", "b5"])
+    units(d, "c", ["c1"])
+    incoming(d, "c-incoming", "c", {"cpu": 1 * K})
+    assert preempted(cycle(d, clock)) == {"b1"}
+
+
+# --- :1969 "can reclaim from queue using less, if taking the latest
+#            workload from the biggest user isn't enough" ----------------
+
+def test_reclaim_from_queue_using_less(use_device):
+    d, clock = make_driver(use_device)
+    admit(d, "a1", "a", {"cpu": ("default", 3 * K)})
+    admit(d, "a2", "a", {"cpu": ("default", 1 * K)})
+    admit(d, "b1", "b", {"cpu": ("default", 2 * K)})
+    admit(d, "b2", "b", {"cpu": ("default", 3 * K)})
+    incoming(d, "c-incoming", "c", {"cpu": 3 * K})
+    assert preempted(cycle(d, clock)) == {"a1"}
+
+
+# --- :1981 "reclaim borrowable quota from user using the most" ----------
+
+def test_reclaim_borrowable_from_biggest_user(use_device):
+    d, clock = make_driver(use_device)
+    units(d, "a", ["a1", "a2", "a3"])
+    units(d, "b", ["b1", "b2", "b3", "b4", "b5"])
+    units(d, "c", ["c1"])
+    incoming(d, "a-incoming", "a", {"cpu": 1 * K})
+    assert preempted(cycle(d, clock)) == {"b1"}
+
+
+# --- :1998 "preempt one from each CQ borrowing" -------------------------
+
+def test_preempt_one_from_each_borrowing_cq(use_device):
+    d, clock = make_driver(use_device)
+    admit(d, "a1", "a", {"cpu": ("default", 500)})
+    admit(d, "a2", "a", {"cpu": ("default", 500)})
+    admit(d, "a3", "a", {"cpu": ("default", 3 * K)})
+    admit(d, "b1", "b", {"cpu": ("default", 500)})
+    admit(d, "b2", "b", {"cpu": ("default", 500)})
+    admit(d, "b3", "b", {"cpu": ("default", 3 * K)})
+    incoming(d, "c-incoming", "c", {"cpu": 2 * K})
+    assert preempted(cycle(d, clock)) == {"a1", "b1"}
+
+
+# --- :2015 "can't preempt when everyone under nominal" ------------------
+
+def test_no_preemption_when_everyone_under_nominal(use_device):
+    d, clock = make_driver(use_device)
+    units(d, "a", ["a1", "a2", "a3"])
+    units(d, "b", ["b1", "b2", "b3"])
+    units(d, "c", ["c1", "c2", "c3"])
+    incoming(d, "c-incoming", "c", {"cpu": 1 * K})
+    stats = cycle(d, clock)
+    assert not stats.admitted and not preempted(stats)
+
+
+# --- :2031 "can't preempt when it would switch the imbalance" -----------
+
+def test_no_preemption_when_it_switches_imbalance(use_device):
+    d, clock = make_driver(use_device)
+    units(d, "a", ["a1", "a2", "a3"])
+    units(d, "b", ["b1", "b2", "b3", "b4", "b5"])
+    incoming(d, "a-incoming", "a", {"cpu": 2 * K})
+    stats = cycle(d, clock)
+    assert not stats.admitted and not preempted(stats)
+
+
+# --- :2046 "can preempt lower priority workloads from same CQ" ----------
+
+def test_preempt_lower_priority_same_cq(use_device):
+    d, clock = make_driver(use_device)
+    units(d, "a", ["a1-low", "a2-low"], priority=-1)
+    units(d, "a", ["a3", "a4"])
+    units(d, "b", ["b1", "b2", "b3", "b4", "b5"])
+    incoming(d, "a-incoming", "a", {"cpu": 2 * K})
+    assert preempted(cycle(d, clock)) == {"a1-low", "a2-low"}
+
+
+# --- :2066 "can preempt a combination of same CQ and highest user" ------
+
+def test_preempt_combination_same_cq_and_biggest_user(use_device):
+    d, clock = make_driver(use_device)
+    units(d, "a", ["a-low"], priority=-1)
+    units(d, "a", ["a2", "a3"])
+    units(d, "b", ["b1", "b2", "b3", "b4", "b5", "b6"])
+    incoming(d, "a-incoming", "a", {"cpu": 2 * K})
+    assert preempted(cycle(d, clock)) == {"a-low", "b1"}
+
+
+# --- :2086 "preempt huge workload if there is no other option" ----------
+
+def test_preempt_huge_workload_when_only_option(use_device):
+    d, clock = make_driver(use_device)
+    admit(d, "b1", "b", {"cpu": ("default", 9 * K)})
+    incoming(d, "a-incoming", "a", {"cpu": 2 * K})
+    assert preempted(cycle(d, clock)) == {"b1"}
+
+
+# --- :2095 "can't preempt huge workload if the incoming is also huge" ---
+
+def test_no_preempt_huge_for_huge_incoming(use_device):
+    d, clock = make_driver(use_device)
+    admit(d, "a1", "a", {"cpu": ("default", 2 * K)})
+    admit(d, "b1", "b", {"cpu": ("default", 7 * K)})
+    incoming(d, "a-incoming", "a", {"cpu": 5 * K})
+    stats = cycle(d, clock)
+    assert not stats.admitted and not preempted(stats)
+
+
+# --- :2104 "can't preempt 2 smaller workloads if the incoming is huge" --
+
+def test_no_preempt_two_smaller_for_huge_incoming(use_device):
+    d, clock = make_driver(use_device)
+    admit(d, "b1", "b", {"cpu": ("default", 2 * K)})
+    admit(d, "b2", "b", {"cpu": ("default", 2 * K)})
+    admit(d, "b3", "b", {"cpu": ("default", 3 * K)})
+    incoming(d, "a-incoming", "a", {"cpu": 6 * K})
+    stats = cycle(d, clock)
+    assert not stats.admitted and not preempted(stats)
+
+
+# --- :2113 "preempt from target and others even if over nominal" --------
+
+def test_preempt_target_and_others_over_nominal(use_device):
+    d, clock = make_driver(use_device)
+    admit(d, "a1-low", "a", {"cpu": ("default", 2 * K)}, priority=-1)
+    admit(d, "a2-low", "a", {"cpu": ("default", 1 * K)}, priority=-1)
+    admit(d, "b1", "b", {"cpu": ("default", 3 * K)})
+    admit(d, "b2", "b", {"cpu": ("default", 3 * K)})
+    incoming(d, "a-incoming", "a", {"cpu": 4 * K})
+    assert preempted(cycle(d, clock)) == {"a1-low", "b1"}
+
+
+# --- :2129 "prefer to preempt workloads that don't make the target CQ
+#            have the biggest share" -------------------------------------
+
+def test_prefer_not_making_target_biggest_share(use_device):
+    d, clock = make_driver(use_device)
+    admit(d, "b1", "b", {"cpu": ("default", 2 * K)})
+    admit(d, "b2", "b", {"cpu": ("default", 1 * K)})
+    admit(d, "b3", "b", {"cpu": ("default", 2 * K)})
+    admit(d, "c1", "c", {"cpu": ("default", 1 * K)})
+    incoming(d, "a-incoming", "a", {"cpu": 3500})
+    assert preempted(cycle(d, clock)) == {"b2"}
+
+
+# --- :2144 "preempt from different cluster queues if the end result has
+#            a smaller max share" ----------------------------------------
+
+def test_preempt_from_different_cqs_smaller_max_share(use_device):
+    d, clock = make_driver(use_device)
+    admit(d, "b1", "b", {"cpu": ("default", 2 * K)})
+    admit(d, "b2", "b", {"cpu": ("default", 2500)})
+    admit(d, "c1", "c", {"cpu": ("default", 2 * K)})
+    admit(d, "c2", "c", {"cpu": ("default", 2500)})
+    incoming(d, "a-incoming", "a", {"cpu": 3500})
+    assert preempted(cycle(d, clock)) == {"b1", "c1"}
+
+
+# --- :2159 "scenario above does not flap" -------------------------------
+
+def test_no_flapping(use_device):
+    d, clock = make_driver(use_device)
+    admit(d, "a1", "a", {"cpu": ("default", 3500)})
+    admit(d, "b2", "b", {"cpu": ("default", 2500)})
+    admit(d, "c2", "c", {"cpu": ("default", 2500)})
+    incoming(d, "b-incoming", "b", {"cpu": 2 * K})
+    stats = cycle(d, clock)
+    assert not stats.admitted and not preempted(stats)
+
+
+# --- :2171 "cannot preempt if it would make the candidate CQ go under
+#            nominal after preempting one element" -----------------------
+
+def test_no_preempt_below_nominal_candidate(use_device):
+    d, clock = make_driver(use_device)
+    admit(d, "b1", "b", {"cpu": ("default", 3 * K)})
+    admit(d, "b2", "b", {"cpu": ("default", 3 * K)})
+    admit(d, "c1", "c", {"cpu": ("default", 3 * K)})
+    incoming(d, "a-incoming", "a", {"cpu": 4 * K})
+    stats = cycle(d, clock)
+    assert not stats.admitted and not preempted(stats)
+
+
+# --- :2186 "workloads under priority threshold not capriciously
+#            preempted" --------------------------------------------------
+
+def test_priority_threshold_not_capricious(use_device):
+    d, clock = make_driver(use_device)
+    units(d, "a", ["a1", "a2", "a3"])
+    units(d, "b", ["b1", "b2", "b3"])
+    units(d, "preemptible", ["p1", "p2", "p3"], priority=-3)
+    incoming(d, "a-incoming", "a", {"cpu": 2 * K})
+    stats = cycle(d, clock)
+    assert not preempted(stats)

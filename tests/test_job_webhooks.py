@@ -298,6 +298,9 @@ def test_rayjob_webhook_rules():
                                 WorkerGroupSpec(name="g")], queue="lq")
     assert any("duplicate group name" in e
                for e in dup.validate_on_create())
+    typo = RayJob("m5", head_requests={"cpu": 100}, worker_groups=[],
+                  submission_mode="k8sjobmode", queue="lq")
+    assert any("submissionMode" in e for e in typo.validate_on_create())
 
 
 def test_rayjob_numofhosts_and_submitter_podsets():

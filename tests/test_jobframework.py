@@ -203,6 +203,7 @@ def test_ray_job_and_deployment():
                 worker_groups=[WorkerGroupSpec(name="gpu-workers",
                                                replicas=2,
                                                requests={"cpu": 2000})],
+                submitter_requests={"cpu": 500},   # cpu-only CQ
                 queue="lq")
     dep = Deployment("serve", replicas=2, requests={"cpu": 500}, queue="lq")
     m.upsert(rj)
