@@ -180,16 +180,20 @@ def _decode_workload(doc: dict) -> Workload:
     for ps in spec.get("podSets", []):
         template_spec = ((ps.get("template") or {}).get("spec") or {})
         requests: dict[str, int] = {}
+        limits: dict[str, int] = {}
         for c in template_spec.get("containers", []):
-            for rname, v in ((c.get("resources") or {})
-                             .get("requests") or {}).items():
+            resources = c.get("resources") or {}
+            for rname, v in (resources.get("requests") or {}).items():
                 requests[rname] = requests.get(rname, 0) + _parse_qty(rname, v)
+            for rname, v in (resources.get("limits") or {}).items():
+                limits[rname] = limits.get(rname, 0) + _parse_qty(rname, v)
         tr = ps.get("topologyRequest") or {}
         pod_sets.append(PodSet(
             name=ps.get("name", "main"),
             count=ps.get("count", 1),
             min_count=ps.get("minCount"),
             requests=requests,
+            limits=limits,
             node_selector=dict(template_spec.get("nodeSelector", {})),
             tolerations=[_decode_toleration(t)
                          for t in template_spec.get("tolerations", [])],

@@ -329,6 +329,9 @@ class PodSet:
     min_count: Optional[int] = None  # partial admission (KEP 420)
     # per-pod resource requests in canonical integer units
     requests: dict[ResourceName, int] = field(default_factory=dict)
+    # optional per-pod limits (requests must not exceed them —
+    # workload.go RequestsMustNotExceedLimitMessage)
+    limits: dict[ResourceName, int] = field(default_factory=dict)
     node_selector: dict[str, str] = field(default_factory=dict)
     tolerations: list[Toleration] = field(default_factory=list)
     labels: dict[str, str] = field(default_factory=dict)
@@ -340,9 +343,12 @@ class PodSet:
     @staticmethod
     def make(name: str = DEFAULT_POD_SET_NAME, count: int = 1,
              requests: dict[ResourceName, int | float | str] | None = None,
+             limits: dict[ResourceName, int | float | str] | None = None,
              **kw) -> "PodSet":
         reqs = {r: quantity_to_int(r, v) for r, v in (requests or {}).items()}
-        return PodSet(name=name, count=count, requests=reqs, **kw)
+        lims = {r: quantity_to_int(r, v) for r, v in (limits or {}).items()}
+        return PodSet(name=name, count=count, requests=reqs, limits=lims,
+                      **kw)
 
 
 @dataclass

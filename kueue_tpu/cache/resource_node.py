@@ -12,6 +12,7 @@ from __future__ import annotations
 from dataclasses import dataclass, field
 from typing import Optional, Protocol
 
+from .. import features
 from ..api.types import ResourceQuota
 from ..resources import FlavorResource, FlavorResourceQuantities
 
@@ -31,9 +32,14 @@ class ResourceNode:
                             usage=self.usage.clone())
 
     def guaranteed_quota(self, fr: FlavorResource) -> int:
-        """Capacity never lent to the cohort (reference resource_node.go:63)."""
+        """Capacity never lent to the cohort (reference resource_node.go:63).
+
+        Ignored entirely while the LendingLimit gate is off (the
+        reference drops the field at cache build,
+        scheduler_test.go:748 disableLendingLimit)."""
         q = self.quotas.get(fr)
-        if q is not None and q.lending_limit is not None:
+        if q is not None and q.lending_limit is not None \
+                and features.enabled("LendingLimit"):
             return max(0, self.subtree_quota.get(fr, 0) - q.lending_limit)
         return 0
 

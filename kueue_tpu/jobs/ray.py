@@ -99,11 +99,12 @@ class RayJob(TemplateJob):
             # the job-submission pod competes for quota too
             # (rayjob_controller.go:155-168)
             # reference default submitter shape: 500m cpu + 200Mi memory
-            # (rayjob_controller.go getSubmitterTemplate)
+            # (rayjob_controller.go getSubmitterTemplate; memory is in
+            # bytes in the canonical units, api/quantity.py)
             templates.append(PodTemplate(
                 name=SUBMITTER, count=1,
                 requests=dict(submitter_requests
-                              or {"cpu": 500, "memory": 200})))
+                              or {"cpu": 500, "memory": 200 << 20})))
         super().__init__(name, templates=templates, **kw)
         self.worker_groups = list(worker_groups)
         self.submission_mode = submission_mode

@@ -655,6 +655,14 @@ class Scheduler:
         if not all(v >= 0 for psr in info.total_requests
                    for v in psr.requests.values()):
             return False
+        # requests must not exceed the pod's own limits
+        # (workload.go RequestsMustNotExceedLimitMessage,
+        # scheduler_test.go:2613)
+        for ps in info.obj.pod_sets:
+            for res, req in ps.requests.items():
+                lim = ps.limits.get(res)
+                if lim is not None and req > lim:
+                    return False
         summary = self.limit_range_summaries.get(info.obj.namespace)
         if summary is not None:
             from ..limitrange import validate as lr_validate
@@ -682,7 +690,9 @@ class Scheduler:
             targets = self.preemptor.get_targets(wl, full, snapshot)
             if targets:
                 return full, targets
-        if self.partial_admission_enabled and self._can_be_partially_admitted(wl):
+        if (self.partial_admission_enabled
+                and features.enabled("PartialAdmission")
+                and self._can_be_partially_admitted(wl)):
             def fits(counts: list[int]):
                 assignment = assigner.assign(counts)
                 m = assignment.representative_mode()

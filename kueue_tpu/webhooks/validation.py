@@ -53,9 +53,14 @@ def valid_dns1123_subdomain(name: str) -> bool:
 
 def default_workload(wl: Workload) -> None:
     """Defaulting (workload_webhook.go Default): single unnamed pod set
-    becomes "main"."""
+    becomes "main"; minCounts are dropped while the PartialAdmission
+    gate is off (workload_webhook.go:61-64)."""
     if len(wl.pod_sets) == 1 and not wl.pod_sets[0].name:
         wl.pod_sets[0].name = "main"
+    from .. import features
+    if not features.enabled("PartialAdmission"):
+        for ps in wl.pod_sets:
+            ps.min_count = None
 
 
 def validate_workload(wl: Workload) -> None:

@@ -342,3 +342,27 @@ spec:
     conds = {c["type"]: c["status"]
              for c in (doc.get("status") or {}).get("conditions", [])}
     assert conds.get("QuotaReserved") == "True", doc
+
+
+def test_manifest_decodes_container_limits():
+    """Container limits land in PodSet.limits so the requests<=limits
+    check (scheduler_test.go:2613) fires for YAML-created workloads."""
+    from kueue_tpu.api.manifests import load_manifests
+    wl, = load_manifests("""
+apiVersion: kueue.x-k8s.io/v1beta1
+kind: Workload
+metadata: {name: capped, namespace: default}
+spec:
+  queueName: lq
+  podSets:
+  - name: one
+    count: 1
+    template:
+      spec:
+        containers:
+        - resources:
+            requests: {cpu: 200m}
+            limits: {cpu: 100m, memory: 1Gi}
+""")
+    assert wl.pod_sets[0].requests == {"cpu": 200}
+    assert wl.pod_sets[0].limits == {"cpu": 100, "memory": 1 << 30}

@@ -907,3 +907,90 @@ def test_preemption_eligible_only_within_nominal(use_device):
     assert not stats.admitted and not stats.preempting, stats
     heap, parked = queue_state(d, "other-alpha")
     assert "eng-alpha/incoming" in heap | parked
+
+
+# --- :748 "lendingLimit should not affect assignments when disabled" ----
+
+def test_lending_limit_ignored_when_gate_disabled(use_device):
+    from kueue_tpu import features
+    with features.set_feature_gate_during_test("LendingLimit", False):
+        d, clock = fixture_driver(use_device)
+        admitted(d, "a", "lend", "lend-b",
+                 [("main", 1, {"cpu": 2000}, {"cpu": "default"})])
+        pending(d, "b", "lend", "lend-b-queue",
+                [("main", 1, {"cpu": 3000})])
+        stats = run_case(d, clock)
+        # with the gate off lend-a's full 3000 is borrowable, not just
+        # its 2000 lendingLimit
+        assert set(stats.admitted) == {"lend/b"}
+    # control: with the gate on the same workload cannot fit
+    d2, clock2 = fixture_driver(use_device)
+    admitted(d2, "a", "lend", "lend-b",
+             [("main", 1, {"cpu": 2000}, {"cpu": "default"})])
+    pending(d2, "b", "lend", "lend-b-queue", [("main", 1, {"cpu": 3000})])
+    stats2 = run_case(d2, clock2)
+    assert not stats2.admitted
+
+
+# --- :2579 "container does not satisfy limitRange constraints" ----------
+
+def test_limitrange_constraints_block_admission(use_device):
+    from kueue_tpu.limitrange import LimitRange, LimitRangeItem
+    d, clock = fixture_driver(use_device)
+    d.apply_limit_range(LimitRange(
+        name="alpha", namespace="sales",
+        items=[LimitRangeItem(type="Container", max={"cpu": 300})]))
+    pending(d, "new", "sales", "main", [("one", 1, {"cpu": 500})])
+    stats = run_case(d, clock)
+    assert not stats.admitted
+    heap, parked = queue_state(d, "sales")
+    assert "sales/new" in heap | parked
+
+
+# --- :2613 "container resource requests exceed limits" ------------------
+
+def test_requests_exceeding_limits_block_admission(use_device):
+    d, clock = fixture_driver(use_device)
+    seq = len(d.workloads) + 1
+    d.create_workload(Workload(
+        name="new", namespace="sales", queue_name="main",
+        creation_time=float(seq),
+        pod_sets=[PodSet(name="one", count=1, requests={"cpu": 200},
+                         limits={"cpu": 100})]))
+    stats = run_case(d, clock)
+    assert not stats.admitted
+    heap, parked = queue_state(d, "sales")
+    assert "sales/new" in heap | parked
+
+
+# --- :1227 "partial admission disabled, variable pod set" ---------------
+
+def test_partial_admission_disabled_gate(use_device):
+    from kueue_tpu import features
+    with features.set_feature_gate_during_test("PartialAdmission", False):
+        d, clock = fixture_driver(use_device)
+        # 60 pods x 1 cpu against sales' 50: with the gate on this would
+        # partially admit at minCount; with it off the webhook drops
+        # minCount at create (workload_webhook.go:61-64) and it parks
+        seq = len(d.workloads) + 1
+        d.create_workload(Workload(
+            name="big", namespace="sales", queue_name="main",
+            creation_time=float(seq),
+            pod_sets=[PodSet(name="one", count=60, min_count=10,
+                             requests={"cpu": 1000})]))
+        assert d.workloads["sales/big"].pod_sets[0].min_count is None
+        run_case(d, clock)
+        heap, parked = queue_state(d, "sales")
+        assert "sales/big" in heap | parked
+        assert d.workloads["sales/big"].admission is None
+    # control: same shape with the gate on partially admits at 50
+    d2, clock2 = fixture_driver(use_device)
+    d2.create_workload(Workload(
+        name="big", namespace="sales", queue_name="main",
+        creation_time=1.0,
+        pod_sets=[PodSet(name="one", count=60, min_count=10,
+                         requests={"cpu": 1000})]))
+    stats2 = run_case(d2, clock2)
+    assert set(stats2.admitted) == {"sales/big"}
+    psa = d2.workloads["sales/big"].admission.pod_set_assignments[0]
+    assert psa.count == 50
