@@ -179,14 +179,29 @@ def _decode_workload(doc: dict) -> Workload:
     pod_sets = []
     for ps in spec.get("podSets", []):
         template_spec = ((ps.get("template") or {}).get("spec") or {})
+        containers = template_spec.get("containers", [])
         requests: dict[str, int] = {}
         limits: dict[str, int] = {}
-        for c in template_spec.get("containers", []):
+        for c in containers:
             resources = c.get("resources") or {}
-            for rname, v in (resources.get("requests") or {}).items():
-                requests[rname] = requests.get(rname, 0) + _parse_qty(rname, v)
-            for rname, v in (resources.get("limits") or {}).items():
-                limits[rname] = limits.get(rname, 0) + _parse_qty(rname, v)
+            c_req = {r: _parse_qty(r, v)
+                     for r, v in (resources.get("requests") or {}).items()}
+            c_lim = {r: _parse_qty(r, v)
+                     for r, v in (resources.get("limits") or {}).items()}
+            for rname, v in c_req.items():
+                requests[rname] = requests.get(rname, 0) + v
+            if len(containers) == 1:
+                limits = c_lim
+            else:
+                # requests<=limits is PER CONTAINER (workload.go
+                # RequestsMustNotExceedLimitMessage); the aggregate can't
+                # express that, so record only a violating container's
+                # limit — the aggregate request is then guaranteed to
+                # exceed it and the scheduler rejects, while clean
+                # multi-container pods carry no limit entry at all
+                for rname, lim in c_lim.items():
+                    if c_req.get(rname, 0) > lim:
+                        limits[rname] = lim
         tr = ps.get("topologyRequest") or {}
         pod_sets.append(PodSet(
             name=ps.get("name", "main"),
@@ -334,9 +349,13 @@ def _encode_workload(wl: Workload) -> dict:
             "name": ps.name, "count": ps.count,
             **({"minCount": ps.min_count} if ps.min_count else {}),
             "template": {"spec": {
-                "containers": [{"name": "main", "resources": {"requests": {
-                    r: _format_qty(r, v) for r, v in ps.requests.items()
-                    if r != "pods"}}}],
+                "containers": [{"name": "main", "resources": {
+                    "requests": {
+                        r: _format_qty(r, v) for r, v in ps.requests.items()
+                        if r != "pods"},
+                    **({"limits": {r: _format_qty(r, v)
+                                   for r, v in ps.limits.items()}}
+                       if ps.limits else {})}}],
                 **({"nodeSelector": dict(ps.node_selector)}
                    if ps.node_selector else {}),
             }}})

@@ -496,6 +496,7 @@ class Workload:
         new.pod_sets = [
             _dc.replace(ps,
                         requests=dict(ps.requests),
+                        limits=dict(ps.limits),
                         node_selector=dict(ps.node_selector),
                         tolerations=list(ps.tolerations),
                         labels=dict(ps.labels),

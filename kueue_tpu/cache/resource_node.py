@@ -12,7 +12,6 @@ from __future__ import annotations
 from dataclasses import dataclass, field
 from typing import Optional, Protocol
 
-from .. import features
 from ..api.types import ResourceQuota
 from ..resources import FlavorResource, FlavorResourceQuantities
 
@@ -34,12 +33,10 @@ class ResourceNode:
     def guaranteed_quota(self, fr: FlavorResource) -> int:
         """Capacity never lent to the cohort (reference resource_node.go:63).
 
-        Ignored entirely while the LendingLimit gate is off (the
-        reference drops the field at cache build,
-        scheduler_test.go:748 disableLendingLimit)."""
+        When the LendingLimit gate is off the limit never reaches this
+        map — build_quotas drops it at cache build."""
         q = self.quotas.get(fr)
-        if q is not None and q.lending_limit is not None \
-                and features.enabled("LendingLimit"):
+        if q is not None and q.lending_limit is not None:
             return max(0, self.subtree_quota.get(fr, 0) - q.lending_limit)
         return 0
 

@@ -28,11 +28,20 @@ MAX_DRS = sys.maxsize  # weight-zero sentinel (reference fair_sharing.go:52)
 
 
 def build_quotas(resource_groups) -> dict[FlavorResource, ResourceQuota]:
-    """Flatten resource groups into the (flavor, resource) → quota map."""
+    """Flatten resource groups into the (flavor, resource) → quota map.
+
+    lendingLimit is dropped at build when its gate is off — the
+    reference does the same at cache build (scheduler_test.go:748
+    disableLendingLimit), keeping the per-cycle hot paths gate-free."""
+    import dataclasses
+    from .. import features
+    lending_on = features.enabled("LendingLimit")
     quotas: dict[FlavorResource, ResourceQuota] = {}
     for rg in resource_groups:
         for fq in rg.flavors:
             for rname, q in fq.resources.items():
+                if q.lending_limit is not None and not lending_on:
+                    q = dataclasses.replace(q, lending_limit=None)
                 quotas[FlavorResource(fq.name, rname)] = q
     return quotas
 

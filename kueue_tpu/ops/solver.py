@@ -414,13 +414,17 @@ class CycleSolver:
                 try:
                     from .. import native
                     if native.available():
+                        # worst-case-shaped sample: every head fits with
+                        # ALL R decision pairs valid, so the sequential
+                        # loop pays its full per-entry cost — a sparse
+                        # sample made native look cheaper than real
+                        # cycles and mis-routed the drain bench
                         n_cq = len(st.cq_names)
                         busy_cq = (np.arange(W)
                                    % max(n_cq, 1)).astype(np.int32)
-                        busy_fr = np.full((W, R), -1, np.int32)
-                        busy_fr[:, 0] = np.arange(W) % F
-                        busy_amt = np.zeros((W, R), np.int32)
-                        busy_amt[:, 0] = 1
+                        busy_fr = np.tile(
+                            (np.arange(R) % F).astype(np.int32), (W, 1))
+                        busy_amt = np.ones((W, R), np.int32)
                         for _ in range(2):
                             t0 = _time.perf_counter()
                             native.admit_scan_raw(

@@ -90,7 +90,6 @@ class Scheduler:
                  fs_preemption_strategies: list[str] | None = None,
                  ordering: Ordering | None = None,
                  clock: Callable[[], float] = time.time,
-                 partial_admission_enabled: bool = True,
                  namespaces: Optional[dict[str, dict[str, str]]] = None,
                  solver: Optional[object] = None):
         self.queues = queues
@@ -98,7 +97,6 @@ class Scheduler:
         self.fair_sharing = fair_sharing
         self.ordering = ordering or Ordering()
         self.clock = clock
-        self.partial_admission_enabled = partial_admission_enabled
         self.namespaces = namespaces  # namespace -> labels (None: match all)
         self.preemptor = Preemptor(
             enable_fair_sharing=fair_sharing,
@@ -690,8 +688,7 @@ class Scheduler:
             targets = self.preemptor.get_targets(wl, full, snapshot)
             if targets:
                 return full, targets
-        if (self.partial_admission_enabled
-                and features.enabled("PartialAdmission")
+        if (features.enabled("PartialAdmission")
                 and self._can_be_partially_admitted(wl)):
             def fits(counts: list[int]):
                 assignment = assigner.assign(counts)

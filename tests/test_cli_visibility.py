@@ -366,3 +366,42 @@ spec:
 """)
     assert wl.pod_sets[0].requests == {"cpu": 200}
     assert wl.pod_sets[0].limits == {"cpu": 100, "memory": 1 << 30}
+    # the field round-trips through encode
+    from kueue_tpu.api.manifests import to_manifest
+    import yaml
+    wl2, = load_manifests(yaml.safe_dump(to_manifest(wl)))
+    assert wl2.pod_sets[0].limits == wl.pod_sets[0].limits
+
+
+def test_manifest_limits_are_per_container():
+    """requests<=limits is a per-container rule: a clean multi-container
+    pod must not be failed by cross-container aggregation, and a
+    violating container must fail even when a sibling has slack."""
+    from kueue_tpu.api.manifests import load_manifests
+    head = """
+apiVersion: kueue.x-k8s.io/v1beta1
+kind: Workload
+metadata: {name: mc, namespace: default}
+spec:
+  queueName: lq
+  podSets:
+  - name: one
+    count: 1
+    template:
+      spec:
+        containers:
+"""
+    # A violates its own limit; B's slack must not mask it
+    bad, = load_manifests(head + """
+        - resources: {requests: {cpu: 200m}, limits: {cpu: 100m}}
+        - resources: {limits: {cpu: 300m}}
+""")
+    ps = bad.pod_sets[0]
+    assert any(ps.requests[r] > lim for r, lim in ps.limits.items())
+    # every container individually fine -> no limit entry to trip over
+    ok, = load_manifests(head + """
+        - resources: {requests: {cpu: 300m}}
+        - resources: {requests: {cpu: 100m}, limits: {cpu: 100m}}
+""")
+    assert ok.pod_sets[0].limits == {}
+    assert ok.pod_sets[0].requests == {"cpu": 400}

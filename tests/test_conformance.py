@@ -994,3 +994,76 @@ def test_partial_admission_disabled_gate(use_device):
     assert set(stats2.admitted) == {"sales/big"}
     psa = d2.workloads["sales/big"].admission.pod_set_assignments[0]
     assert psa.count == 50
+
+
+# --- :939 "no overadmission while borrowing" ----------------------------
+
+def test_no_overadmission_while_borrowing(use_device):
+    gamma = ClusterQueue(
+        name="eng-gamma", cohort="eng",
+        preemption=PreemptionPolicy(
+            reclaim_within_cohort=ReclaimWithinCohort.ANY,
+            within_cluster_queue=WithinClusterQueue.LOWER_PRIORITY),
+        resource_groups=[ResourceGroup(covered_resources=["cpu"], flavors=[
+            FlavorQuotas(name="on-demand", resources={
+                "cpu": ResourceQuota(nominal=50_000,
+                                     borrowing_limit=10_000)}),
+            FlavorQuotas(name="spot", resources={
+                "cpu": ResourceQuota(nominal=0,
+                                     borrowing_limit=100_000)})])])
+    d, clock = fixture_driver(
+        use_device, extra_cqs=[gamma],
+        extra_lqs=[("eng-gamma", "main", "eng-gamma")])
+    # admitted() usage is the podset TOTAL; pending() requests are per pod
+    admitted(d, "existing", "eng-gamma", "eng-gamma", [
+        ("borrow-on-demand", 51, {"cpu": 51_000}, {"cpu": "on-demand"}),
+        ("use-all-spot", 100, {"cpu": 100_000}, {"cpu": "spot"})])
+    pending(d, "new", "eng-beta", "main", [("one", 50, {"cpu": 1000})],
+            created=1.0)
+    pending(d, "new-alpha", "eng-alpha", "main",
+            [("one", 1, {"cpu": 1000})], created=2.0)
+    pending(d, "new-gamma", "eng-gamma", "main",
+            [("one", 50, {"cpu": 1000})], created=3.0)
+    stats = run_case(d, clock)
+    assert set(stats.admitted) == {"eng-beta/new", "eng-alpha/new-alpha"}
+    assert not stats.preempted_targets
+    assert flavors_of(d, "eng-beta/new") == {"one": {"cpu": "on-demand"}}
+    assert flavors_of(d, "eng-alpha/new-alpha") \
+        == {"one": {"cpu": "on-demand"}}
+    heap, parked = queue_state(d, "eng-gamma")
+    assert "eng-gamma/new-gamma" in heap | parked
+    # the pre-admitted borrower keeps both pod sets untouched
+    assert flavors_of(d, "eng-gamma/existing") == {
+        "borrow-on-demand": {"cpu": "on-demand"},
+        "use-all-spot": {"cpu": "spot"}}
+
+
+# --- :2655 "prefer reclamation over cq priority based preemption" -------
+
+def test_prefer_reclamation_over_cq_priority_preemption(use_device):
+    policy = PreemptionPolicy(
+        within_cluster_queue=WithinClusterQueue.LOWER_PRIORITY,
+        reclaim_within_cohort=ReclaimWithinCohort.LOWER_PRIORITY)
+    mk = lambda name, nominal: ClusterQueue(
+        name=name, cohort="other", preemption=policy,
+        resource_groups=[ResourceGroup(covered_resources=["gpu"], flavors=[
+            FlavorQuotas(name="on-demand", resources={
+                "gpu": ResourceQuota(nominal=nominal)}),
+            FlavorQuotas(name="spot", resources={
+                "gpu": ResourceQuota(nominal=nominal)})])])
+    d, clock = fixture_driver(
+        use_device, extra_cqs=[mk("other-alpha", 10), mk("other-beta", 0)],
+        extra_lqs=[("eng-alpha", "other", "other-alpha"),
+                   ("eng-beta", "other", "other-beta")])
+    admitted(d, "a1", "eng-alpha", "other-alpha",
+             [("main", 1, {"gpu": 5}, {"gpu": "on-demand"})], priority=50)
+    admitted(d, "b1", "eng-beta", "other-beta",
+             [("main", 1, {"gpu": 5}, {"gpu": "spot"})], priority=50)
+    pending(d, "preemptor", "eng-alpha", "other",
+            [("main", 1, {"gpu": 6})], priority=100)
+    stats = run_case(d, clock)
+    # flavor 1 (on-demand) would preempt a1 inside the CQ; flavor 2
+    # (spot) reclaims the borrower b1 from the cohort — reclamation wins
+    assert set(stats.preempted_targets) == {"eng-beta/b1"}
+    assert "eng-alpha/preemptor" not in stats.admitted
+    assert flavors_of(d, "eng-alpha/a1") == {"main": {"gpu": "on-demand"}}
