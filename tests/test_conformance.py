@@ -1067,3 +1067,140 @@ def test_prefer_reclamation_over_cq_priority_preemption(use_device):
     assert set(stats.preempted_targets) == {"eng-beta/b1"}
     assert "eng-alpha/preemptor" not in stats.admitted
     assert flavors_of(d, "eng-alpha/a1") == {"main": {"gpu": "on-demand"}}
+
+
+# --- :1089/:1129 partial admission preempt variants ----------------------
+
+def test_partial_admission_preempt_first(use_device):
+    d, clock = fixture_driver(use_device)
+    admitted(d, "old", "eng-beta", "eng-beta",
+             [("one", 10, {"example.com/gpu": 10},
+               {"example.com/gpu": "model-a"})], priority=-4)
+    seq = len(d.workloads) + 1
+    d.create_workload(Workload(
+        name="new", namespace="eng-beta", queue_name="main", priority=4,
+        creation_time=float(seq),
+        pod_sets=[PodSet(name="one", count=20, min_count=10,
+                         requests={"example.com/gpu": 1})]))
+    stats = run_case(d, clock)
+    # the full 20 fits once old's 10 are preempted — no count reduction
+    assert set(stats.preempted_targets) == {"eng-beta/old"}
+    assert "eng-beta/new" not in stats.admitted
+    heap, parked = queue_state(d, "eng-beta")
+    assert "eng-beta/new" in heap | parked
+
+
+def test_partial_admission_preempt_with_reduction(use_device):
+    d, clock = fixture_driver(use_device)
+    admitted(d, "old", "eng-beta", "eng-beta",
+             [("one", 10, {"example.com/gpu": 10},
+               {"example.com/gpu": "model-a"})], priority=-4)
+    seq = len(d.workloads) + 1
+    d.create_workload(Workload(
+        name="new", namespace="eng-beta", queue_name="main", priority=4,
+        creation_time=float(seq),
+        pod_sets=[PodSet(name="one", count=30, min_count=10,
+                         requests={"example.com/gpu": 1})]))
+    stats = run_case(d, clock)
+    # 30 can never fit the 20-gpu nominal; the reducer finds a count
+    # that becomes feasible after preempting old
+    assert set(stats.preempted_targets) == {"eng-beta/old"}
+    assert "eng-beta/new" not in stats.admitted
+    heap, parked = queue_state(d, "eng-beta")
+    assert "eng-beta/new" in heap | parked
+
+
+# --- :2716/:2779 flavor preference among preemption kinds ---------------
+
+def _other_cohort_driver(use_device):
+    policy = PreemptionPolicy(
+        within_cluster_queue=WithinClusterQueue.LOWER_PRIORITY,
+        reclaim_within_cohort=ReclaimWithinCohort.LOWER_PRIORITY)
+    mk = lambda name, nominal, pre: ClusterQueue(
+        name=name, cohort="other", preemption=pre,
+        resource_groups=[ResourceGroup(covered_resources=["gpu"], flavors=[
+            FlavorQuotas(name="on-demand", resources={
+                "gpu": ResourceQuota(nominal=nominal)}),
+            FlavorQuotas(name="spot", resources={
+                "gpu": ResourceQuota(nominal=nominal)})])])
+    return fixture_driver(
+        use_device,
+        extra_cqs=[mk("other-alpha", 10, policy),
+                   mk("other-beta", 0, PreemptionPolicy())],
+        extra_lqs=[("eng-alpha", "other", "other-alpha"),
+                   ("eng-beta", "other", "other-beta")])
+
+
+def test_prefer_first_flavor_when_second_needs_reclaim_and_cq(use_device):
+    d, clock = _other_cohort_driver(use_device)
+    admitted(d, "a1", "eng-alpha", "other-alpha",
+             [("main", 1, {"gpu": 5}, {"gpu": "on-demand"})], priority=50)
+    admitted(d, "a2", "eng-alpha", "other-alpha",
+             [("main", 1, {"gpu": 5}, {"gpu": "spot"})], priority=50)
+    admitted(d, "b1", "eng-beta", "other-beta",
+             [("main", 1, {"gpu": 5}, {"gpu": "spot"})], priority=50)
+    pending(d, "preemptor", "eng-alpha", "other",
+            [("main", 1, {"gpu": 6})], priority=100)
+    stats = run_case(d, clock)
+    # spot would need BOTH cohort reclaim and in-CQ preemption — it does
+    # not improve on on-demand's single in-CQ preemption
+    assert set(stats.preempted_targets) == {"eng-alpha/a1"}
+    assert flavors_of(d, "eng-alpha/a2") == {"main": {"gpu": "spot"}}
+    assert flavors_of(d, "eng-beta/b1") == {"main": {"gpu": "spot"}}
+
+
+def test_prefer_first_flavor_when_second_also_needs_cq_preemption(use_device):
+    d, clock = _other_cohort_driver(use_device)
+    admitted(d, "a1", "eng-alpha", "other-alpha",
+             [("main", 1, {"gpu": 6}, {"gpu": "on-demand"})], priority=50)
+    admitted(d, "a2", "eng-alpha", "other-alpha",
+             [("main", 1, {"gpu": 5}, {"gpu": "spot"})], priority=50)
+    admitted(d, "b1", "eng-beta", "other-beta",
+             [("main", 1, {"gpu": 5}, {"gpu": "spot"})], priority=9001)
+    pending(d, "preemptor", "eng-alpha", "other",
+            [("main", 1, {"gpu": 5})], priority=100)
+    stats = run_case(d, clock)
+    # the spot borrower is too high priority to reclaim, so spot also
+    # needs in-CQ preemption — flavor order breaks the tie
+    assert set(stats.preempted_targets) == {"eng-alpha/a1"}
+    assert flavors_of(d, "eng-alpha/a2") == {"main": {"gpu": "spot"}}
+
+
+# --- :2844 "workload requiring reclamation prioritized over wl in
+#            another full cq" (issue #3405) ------------------------------
+
+def test_reclaiming_workload_prioritized_over_full_cq_workload(use_device):
+    mk = lambda name, nominal, pre: ClusterQueue(
+        name=name, cohort="other", preemption=pre or PreemptionPolicy(),
+        resource_groups=[ResourceGroup(covered_resources=["gpu"], flavors=[
+            FlavorQuotas(name="on-demand", resources={
+                "gpu": ResourceQuota(nominal=nominal)})])])
+    d, clock = fixture_driver(
+        use_device,
+        extra_cqs=[
+            mk("cq1", 10, None),
+            mk("cq2", 10, PreemptionPolicy(
+                reclaim_within_cohort=ReclaimWithinCohort.ANY)),
+            mk("cq3", 0, None)],
+        extra_lqs=[("eng-alpha", "lq", "cq1"), ("eng-beta", "lq", "cq2"),
+                   ("eng-gamma", "lq", "cq3")])
+    admitted(d, "aw1", "eng-alpha", "cq1",
+             [("main", 1, {"gpu": 5}, {"gpu": "on-demand"})])
+    admitted(d, "aw2", "eng-gamma", "cq3",
+             [("main", 1, {"gpu": 5}, {"gpu": "on-demand"})], priority=0)
+    admitted(d, "aw3", "eng-gamma", "cq3",
+             [("main", 1, {"gpu": 5}, {"gpu": "on-demand"})], priority=1)
+    pending(d, "wl1", "eng-alpha", "lq", [("main", 1, {"gpu": 10})],
+            created=100.0)
+    pending(d, "wl2", "eng-beta", "lq", [("main", 1, {"gpu": 10})],
+            created=101.0)
+    stats = run_case(d, clock)
+    # wl2 reclaims its nominal capacity (preempting the borrower) even
+    # though the earlier-created wl1 would otherwise reserve first and
+    # invalidate the preemption calculation (issue #3405)
+    assert set(stats.preempted_targets) == {"eng-gamma/aw2"}
+    assert not stats.admitted
+    h1, p1 = queue_state(d, "cq1")
+    assert "eng-alpha/wl1" in h1 | p1
+    h2, p2 = queue_state(d, "cq2")
+    assert "eng-beta/wl2" in h2 | p2
