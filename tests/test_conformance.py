@@ -1204,3 +1204,79 @@ def test_reclaiming_workload_prioritized_over_full_cq_workload(use_device):
     assert "eng-alpha/wl1" in h1 | p1
     h2, p2 = queue_state(d, "cq2")
     assert "eng-beta/wl2" in h2 | p2
+
+
+# --- :1751 "fair sharing schedule singleton cqs and cq without cohort" --
+
+def test_fs_singleton_cqs_and_no_cohort(use_device):
+    d, clock = fixture_driver(
+        use_device, fair_sharing=True,
+        extra_cohorts=[
+            Cohort(name="cohort-a", resource_groups=[ResourceGroup(
+                covered_resources=["cpu"], flavors=[
+                    FlavorQuotas(name="on-demand", resources={
+                        "cpu": ResourceQuota(nominal=10_000)})])]),
+            Cohort(name="cohort-b")],
+        extra_cqs=[
+            ClusterQueue(name="a", cohort="cohort-a",
+                         resource_groups=[ResourceGroup(
+                             covered_resources=["cpu"], flavors=[
+                                 FlavorQuotas(name="on-demand", resources={
+                                     "cpu": ResourceQuota(nominal=0)})])]),
+            ClusterQueue(name="b", cohort="cohort-b",
+                         resource_groups=[ResourceGroup(
+                             covered_resources=["cpu"], flavors=[
+                                 FlavorQuotas(name="on-demand", resources={
+                                     "cpu": ResourceQuota(
+                                         nominal=10_000)})])]),
+            ClusterQueue(name="c",
+                         resource_groups=[ResourceGroup(
+                             covered_resources=["cpu"], flavors=[
+                                 FlavorQuotas(name="on-demand", resources={
+                                     "cpu": ResourceQuota(
+                                         nominal=10_000)})])])],
+        extra_lqs=[("eng-alpha", "lq-a", "a"), ("eng-alpha", "lq-b", "b"),
+                   ("eng-alpha", "lq-c", "c")])
+    pending(d, "a1", "eng-alpha", "lq-a", [("one", 1, {"cpu": 10_000})])
+    pending(d, "b1", "eng-alpha", "lq-b", [("one", 1, {"cpu": 10_000})])
+    pending(d, "c1", "eng-alpha", "lq-c", [("one", 1, {"cpu": 10_000})])
+    stats = run_case(d, clock)
+    # a borrows the cohort-level quota; singleton cohorts and the
+    # cohortless CQ all admit in one cycle under fair sharing
+    assert set(stats.admitted) == {"eng-alpha/a1", "eng-alpha/b1",
+                                   "eng-alpha/c1"}
+    assert flavors_of(d, "eng-alpha/a1") == {"one": {"cpu": "on-demand"}}
+
+
+# --- :2067 "with fair sharing: preempt workload from CQ with the
+#            highest share" ----------------------------------------------
+
+def test_fs_preempt_from_cq_with_highest_share(use_device):
+    gamma = ClusterQueue(
+        name="eng-gamma", cohort="eng",
+        resource_groups=[ResourceGroup(covered_resources=["cpu"], flavors=[
+            FlavorQuotas(name="on-demand", resources={
+                "cpu": ResourceQuota(nominal=50_000,
+                                     borrowing_limit=0)})])])
+    d, clock = fixture_driver(use_device, fair_sharing=True,
+                              extra_cqs=[gamma])
+    admitted(d, "all-spot", "eng-alpha", "eng-alpha",
+             [("main", 1, {"cpu": 100_000}, {"cpu": "spot"})])
+    for i in range(1, 5):
+        admitted(d, f"alpha{i}", "eng-alpha", "eng-alpha",
+                 [("main", 1, {"cpu": 20_000}, {"cpu": "on-demand"})])
+    admitted(d, "gamma1", "eng-gamma", "eng-gamma",
+             [("main", 1, {"cpu": 10_000}, {"cpu": "on-demand"})])
+    for i in range(2, 5):
+        admitted(d, f"gamma{i}", "eng-gamma", "eng-gamma",
+                 [("main", 1, {"cpu": 20_000}, {"cpu": "on-demand"})])
+    pending(d, "preemptor", "eng-beta", "main",
+            [("main", 1, {"cpu": 30_000})])
+    stats = run_case(d, clock)
+    # fair preemption takes the cheapest workloads from BOTH borrowers
+    # (alpha and gamma carry the highest DRS)
+    assert set(stats.preempted_targets) == {"eng-alpha/alpha1",
+                                            "eng-gamma/gamma1"}
+    assert "eng-beta/preemptor" not in stats.admitted
+    heap, parked = queue_state(d, "eng-beta")
+    assert "eng-beta/preemptor" in heap | parked
