@@ -1280,3 +1280,48 @@ def test_fs_preempt_from_cq_with_highest_share(use_device):
     assert "eng-beta/preemptor" not in stats.admitted
     heap, parked = queue_state(d, "eng-beta")
     assert "eng-beta/preemptor" in heap | parked
+
+
+# --- :2343 "multiple preemptions within cq when fair sharing" -----------
+
+def test_fs_multiple_within_cq_preemptions_one_cycle(use_device):
+    # the reference fixture leaves reclaimWithinCohort UNSET, which its
+    # canPreemptWhileBorrowing treats as != Never (flavorassigner.go:
+    # canPreemptWhileBorrowing); with CRD defaulting the effective
+    # policy is reclaim Any, which our defaulted model states explicitly
+    lower = PreemptionPolicy(
+        within_cluster_queue=WithinClusterQueue.LOWER_PRIORITY,
+        reclaim_within_cohort=ReclaimWithinCohort.ANY)
+    mk = lambda name, nominal, pre=None: ClusterQueue(
+        name=name, cohort="other",
+        preemption=pre or PreemptionPolicy(),
+        resource_groups=[ResourceGroup(covered_resources=["cpu"], flavors=[
+            FlavorQuotas(name="default", resources={
+                "cpu": ResourceQuota(nominal=nominal)})])])
+    d, clock = fixture_driver(
+        use_device, fair_sharing=True,
+        extra_cqs=[mk("other-alpha", 2000, lower),
+                   mk("other-beta", 2000, lower),
+                   mk("other-gamma", 2000, lower),
+                   mk("resource-bank", 3000)],
+        extra_lqs=[("eng-alpha", "other", "other-alpha"),
+                   ("eng-beta", "other", "other-beta"),
+                   ("eng-gamma", "other", "other-gamma")])
+    admitted(d, "a1", "eng-alpha", "other-alpha",
+             [("main", 1, {"cpu": 3000}, {"cpu": "default"})])
+    admitted(d, "b1", "eng-beta", "other-beta",
+             [("main", 1, {"cpu": 3000}, {"cpu": "default"})])
+    admitted(d, "c1", "eng-gamma", "other-gamma",
+             [("main", 1, {"cpu": 3000}, {"cpu": "default"})])
+    pending(d, "preemptor", "eng-alpha", "other",
+            [("main", 1, {"cpu": 3000})], priority=100)
+    pending(d, "preemptor", "eng-beta", "other",
+            [("main", 1, {"cpu": 3000})], priority=100)
+    pending(d, "preemptor", "eng-gamma", "other",
+            [("main", 1, {"cpu": 3000})], priority=100)
+    stats = run_case(d, clock)
+    # every CQ preempts within itself in the SAME cycle — fair sharing
+    # must not serialize non-overlapping preemptions
+    assert set(stats.preempted_targets) == {
+        "eng-alpha/a1", "eng-beta/b1", "eng-gamma/c1"}
+    assert not stats.admitted

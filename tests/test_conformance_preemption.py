@@ -356,3 +356,42 @@ def test_reclaim_quota_from_lender(use_device):
     incoming(d, "in", "lend1", {"cpu": 3 * K}, priority=1)
     stats = cycle(d, clock)
     assert preempted(stats) == {"lend2-mid"}
+
+
+# --- :2713 TestCandidatesOrdering ---------------------------------------
+
+def test_candidates_ordering():
+    """Transliterates the reference's ordering table exactly: evicted
+    first, then other-CQ, then lower priority, then later admission,
+    then uid."""
+    from kueue_tpu.scheduler.preemption import candidates_ordering_key
+    from kueue_tpu.workload import (WL_EVICTED, Condition, ConditionStatus,
+                                    Info)
+
+    now = 1000.0
+
+    def info(name, cq="self", priority=0, at=now, evicted=False):
+        wl = Workload(name=name, namespace="", priority=priority,
+                      creation_time=at)
+        if evicted:
+            wl.conditions[WL_EVICTED] = Condition(
+                type=WL_EVICTED, status=ConditionStatus.TRUE,
+                last_transition_time=now)
+        else:
+            adm = Admission(cluster_queue=cq, pod_set_assignments=[])
+            set_quota_reservation(wl, adm, at)
+        return Info(wl)
+
+    candidates = [
+        info("high", priority=10),
+        info("low", priority=-10),
+        info("other", cq="other", priority=10),
+        info("evicted", evicted=True),
+        info("old-a"),
+        info("old-b"),
+        info("current", at=now + 1.0),
+    ]
+    candidates.sort(key=candidates_ordering_key("self", now))
+    got = [c.obj.name for c in candidates]
+    assert got == ["evicted", "other", "low", "current", "old-a",
+                   "old-b", "high"], got
