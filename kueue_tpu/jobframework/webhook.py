@@ -103,11 +103,16 @@ def validate_job_update(old: GenericJob, new: GenericJob) -> None:
     priority class is always immutable; max-exec-time is immutable
     unless both versions are suspended."""
     errors = _job_errors_create(new)
-    if not new.is_suspended():
-        if new.queue_name != old.queue_name:
+    if new.queue_name != old.queue_name:
+        # serving kinds freeze the queue on their own condition (e.g.
+        # StatefulSet: once pods are Ready, statefulset_webhook.go:140)
+        frozen = getattr(new, "queue_name_frozen", None)
+        if (frozen(old) if frozen is not None
+                else not new.is_suspended()):
             errors.append(
                 "metadata.labels[kueue.x-k8s.io/queue-name]: "
-                "field is immutable while the job is not suspended")
+                "field is immutable")
+    if not new.is_suspended():
         old_pb = getattr(old, "prebuilt_workload", None)
         if getattr(new, "prebuilt_workload", None) != old_pb:
             errors.append(

@@ -29,12 +29,42 @@ class StatefulSet(TemplateJob):
                  requests: dict[str, int], **kw):
         super().__init__(name, templates=[PodTemplate(
             name="main", count=replicas, requests=dict(requests))], **kw)
+        # status mirrors: pods Ready / pods still existing (the webhook
+        # consults both, statefulset_webhook.go:140,168)
+        self.ready_replicas = 0
+        self.status_replicas = 0
         self.deleted = False
+
+    @property
+    def replicas(self) -> int:
+        """The spec replica count (the template count may be reduced by
+        partial admission; _original holds the spec)."""
+        return self._original[0].count
 
     def finished(self) -> tuple[str, bool, bool]:
         if self.deleted:
             return "StatefulSet deleted", True, True
         return "", False, False
+
+    def queue_name_frozen(self, old: "StatefulSet") -> bool:
+        """statefulset_webhook.go:140: the queue can move until pods
+        are Ready; removing the label is always forbidden."""
+        return old.ready_replicas > 0 or not self.queue_name
+
+    def validate_on_update(self, old: "StatefulSet") -> list[str]:
+        """statefulset_webhook.go:155-171: replicas only scale to/from
+        zero (#3279), and not up from zero while the previous
+        scale-down is still terminating."""
+        errors = []
+        if (self.replicas != 0 and old.replicas != 0
+                and self.replicas != old.replicas):
+            errors.append("spec.replicas: field is immutable "
+                          "(only scaling to or from zero is supported)")
+        if (old.replicas == 0 and self.replicas > 0
+                and old.status_replicas > 0):
+            errors.append(
+                "spec.replicas: scaling down is still in progress")
+        return errors
 
 
 class Deployment(TemplateJob):

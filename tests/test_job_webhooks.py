@@ -255,7 +255,7 @@ def test_queue_name_immutable_while_running():
     moved.suspended = False
     with pytest.raises(ValidationError) as ei:
         validate_job_update(job, moved)
-    assert "immutable while the job is not suspended" in str(ei.value)
+    assert "queue-name]: field is immutable" in str(ei.value)
     # while suspended the move is allowed
     job2 = BatchJob("mv", parallelism=1, requests={"cpu": 100}, queue="lq")
     moved2 = BatchJob("mv", parallelism=1, requests={"cpu": 100},
@@ -331,3 +331,40 @@ def test_jobset_webhook_rules():
     assert any("duplicate replicated job" in e for e in errs)
     assert any("replicas: should be >= 1" in e for e in errs)
     assert any("parallelism: should be >= 1" in e for e in errs)
+
+
+def test_statefulset_update_rules():
+    """statefulset_webhook.go:130-171 — replicas scale only to/from
+    zero; queue-name frozen once pods are Ready; no scale-up while the
+    previous scale-down is terminating."""
+    from kueue_tpu.jobs.serving import StatefulSet
+    old = StatefulSet("web", replicas=3, requests={"cpu": 100}, queue="lq")
+    resized = StatefulSet("web", replicas=5, requests={"cpu": 100},
+                          queue="lq")
+    errs = resized.validate_on_update(old)
+    assert any("only scaling to or from zero" in e for e in errs)
+    # scale to zero and back are allowed
+    to_zero = StatefulSet("web", replicas=0, requests={"cpu": 100},
+                          queue="lq")
+    assert to_zero.validate_on_update(old) == []
+    from_zero = StatefulSet("web", replicas=3, requests={"cpu": 100},
+                            queue="lq")
+    assert from_zero.validate_on_update(to_zero) == []
+    # ... unless the old pods are still terminating
+    to_zero.status_replicas = 2
+    assert any("scaling down is still in progress" in e
+               for e in from_zero.validate_on_update(to_zero))
+    # queue move allowed before pods are Ready, frozen after — through
+    # the REAL dispatcher (webhook.py consults queue_name_frozen)
+    moved = StatefulSet("web", replicas=3, requests={"cpu": 100},
+                        queue="other")
+    validate_job_update(old, moved)            # 0 ready: move allowed
+    old.ready_replicas = 3
+    with pytest.raises(ValidationError) as ei:
+        validate_job_update(old, moved)
+    assert "queue-name]: field is immutable" in str(ei.value)
+    # removing the label is always forbidden, even with nothing ready
+    old.ready_replicas = 0
+    removed = StatefulSet("web", replicas=3, requests={"cpu": 100})
+    with pytest.raises(ValidationError):
+        validate_job_update(old, removed)
