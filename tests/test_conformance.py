@@ -14,6 +14,8 @@ import pytest
 
 from kueue_tpu.api.types import (
     Admission,
+    BorrowWithinCohort,
+    BorrowWithinCohortPolicy,
     ClusterQueue,
     Cohort,
     FlavorQuotas,
@@ -1325,3 +1327,42 @@ def test_fs_multiple_within_cq_preemptions_one_cycle(use_device):
     assert set(stats.preempted_targets) == {
         "eng-alpha/a1", "eng-beta/b1", "eng-gamma/c1"}
     assert not stats.admitted
+
+
+# --- :1356 "preemption while borrowing, workload waiting for preemption
+#            should not block a borrowing workload in another CQ" --------
+
+def test_waiting_preemptor_does_not_block_borrower(use_device):
+    borrow_lp = PreemptionPolicy(
+        reclaim_within_cohort=ReclaimWithinCohort.LOWER_PRIORITY,
+        borrow_within_cohort=BorrowWithinCohort(
+            policy=BorrowWithinCohortPolicy.LOWER_PRIORITY))
+    mk = lambda name, nominal, blimit, pre: ClusterQueue(
+        name=name, cohort="preemption-while-borrowing",
+        preemption=pre or PreemptionPolicy(),
+        resource_groups=[ResourceGroup(covered_resources=["cpu"], flavors=[
+            FlavorQuotas(name="default", resources={
+                "cpu": ResourceQuota(nominal=nominal,
+                                     borrowing_limit=blimit)})])])
+    d, clock = fixture_driver(
+        use_device,
+        extra_cqs=[mk("cq-shared", 4000, 0, None),
+                   mk("cq-a", 0, 3000, borrow_lp),
+                   mk("cq-b", 0, None, borrow_lp)],
+        extra_lqs=[("eng-alpha", "lq-a", "cq-a"),
+                   ("eng-beta", "lq-b", "cq-b")])
+    admitted(d, "admitted-a", "eng-alpha", "cq-a",
+             [("main", 1, {"cpu": 2000}, {"cpu": "default"})])
+    pending(d, "a", "eng-alpha", "lq-a", [("main", 1, {"cpu": 3000})],
+            created=100.0)
+    pending(d, "b", "eng-beta", "lq-b", [("main", 1, {"cpu": 1000})],
+            created=101.0)
+    stats = run_case(d, clock)
+    # "a" can't fit (cq-a would exceed its borrowingLimit) and reserves
+    # nothing — the later-created borrower "b" still admits this cycle
+    assert set(stats.admitted) == {"eng-beta/b"}
+    assert not stats.preempted_targets
+    heap, parked = queue_state(d, "cq-a")
+    assert "eng-alpha/a" in heap | parked
+    assert flavors_of(d, "eng-alpha/admitted-a") == {
+        "main": {"cpu": "default"}}
