@@ -22,6 +22,13 @@ from ..jobframework.interface import (
 from .base import PodTemplate, TemplateJob
 
 
+def _serving_queue_frozen(new, old) -> bool:
+    """Shared serving-kind freeze rule ({statefulset,deployment}
+    _webhook.go): the queue can move until pods are Ready; removing the
+    label is always forbidden."""
+    return old.ready_replicas > 0 or not new.queue_name
+
+
 class StatefulSet(TemplateJob):
     kind = "StatefulSet"
 
@@ -47,9 +54,7 @@ class StatefulSet(TemplateJob):
         return "", False, False
 
     def queue_name_frozen(self, old: "StatefulSet") -> bool:
-        """statefulset_webhook.go:140: the queue can move until pods
-        are Ready; removing the label is always forbidden."""
-        return old.ready_replicas > 0 or not self.queue_name
+        return _serving_queue_frozen(self, old)   # statefulset_webhook.go:140
 
     def validate_on_update(self, old: "StatefulSet") -> list[str]:
         """statefulset_webhook.go:155-171: replicas only scale to/from
@@ -78,6 +83,7 @@ class Deployment(TemplateJob):
                  requests: dict[str, int], **kw):
         super().__init__(name, templates=[PodTemplate(
             name="main", count=replicas, requests=dict(requests))], **kw)
+        self.ready_replicas = 0
         self.deleted = False
 
     def scale(self, replicas: int) -> None:
@@ -88,6 +94,9 @@ class Deployment(TemplateJob):
         if self.deleted:
             return "Deployment deleted", True, True
         return "", False, False
+
+    def queue_name_frozen(self, old: "Deployment") -> bool:
+        return _serving_queue_frozen(self, old)   # deployment_webhook.go:131
 
 
 @dataclass

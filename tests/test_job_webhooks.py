@@ -368,3 +368,23 @@ def test_statefulset_update_rules():
     removed = StatefulSet("web", replicas=3, requests={"cpu": 100})
     with pytest.raises(ValidationError):
         validate_job_update(old, removed)
+
+
+def test_deployment_queue_freeze_on_ready_pods():
+    """deployment_webhook.go:131 — queue moves allowed until pods are
+    Ready; label removal always forbidden."""
+    from kueue_tpu.jobs.serving import Deployment
+    old = Deployment("serve", replicas=2, requests={"cpu": 100},
+                     queue="lq")
+    old.suspended = False
+    moved = Deployment("serve", replicas=2, requests={"cpu": 100},
+                       queue="fast")
+    moved.suspended = False
+    validate_job_update(old, moved)
+    old.ready_replicas = 1
+    with pytest.raises(ValidationError):
+        validate_job_update(old, moved)
+    old.ready_replicas = 0
+    removed = Deployment("serve", replicas=2, requests={"cpu": 100})
+    with pytest.raises(ValidationError):
+        validate_job_update(old, removed)
