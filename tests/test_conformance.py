@@ -1366,3 +1366,46 @@ def test_waiting_preemptor_does_not_block_borrower(use_device):
     assert "eng-alpha/a" in heap | parked
     assert flavors_of(d, "eng-alpha/admitted-a") == {
         "main": {"cpu": "default"}}
+
+
+# --- :2257 "multiple preemptions skip preemption when shared limited
+#            resource" ---------------------------------------------------
+
+def test_skip_wasteful_preemption_on_shared_limited_resource(use_device):
+    # the reference fixture's borrowWithinCohort with unset (zero-value)
+    # reclaimWithinCohort would be rejected by the CQ webhook
+    # (clusterqueue_webhook.go); the valid equivalent sets reclaim
+    pre = PreemptionPolicy(
+        within_cluster_queue=WithinClusterQueue.LOWER_PRIORITY,
+        reclaim_within_cohort=ReclaimWithinCohort.LOWER_PRIORITY,
+        borrow_within_cohort=BorrowWithinCohort(
+            policy=BorrowWithinCohortPolicy.LOWER_PRIORITY))
+    mk = lambda name, nominal, p=None: ClusterQueue(
+        name=name, cohort="other", preemption=p or PreemptionPolicy(),
+        resource_groups=[ResourceGroup(covered_resources=["cpu"], flavors=[
+            FlavorQuotas(name="default", resources={
+                "cpu": ResourceQuota(nominal=nominal)})])])
+    d, clock = fixture_driver(
+        use_device,
+        extra_cqs=[mk("other-alpha", 2000, pre), mk("other-beta", 2000, pre),
+                   mk("resource-bank", 1000)],
+        extra_lqs=[("eng-alpha", "other", "other-alpha"),
+                   ("eng-beta", "other", "other-beta")])
+    admitted(d, "a1", "eng-alpha", "other-alpha",
+             [("main", 1, {"cpu": 2000}, {"cpu": "default"})])
+    admitted(d, "b1", "eng-beta", "other-beta",
+             [("main", 1, {"cpu": 2000}, {"cpu": "default"})])
+    pending(d, "preemptor", "eng-alpha", "other",
+            [("main", 1, {"cpu": 3000})], priority=100)
+    pending(d, "pretending-preemptor", "eng-beta", "other",
+            [("main", 1, {"cpu": 3000})], priority=99)
+    stats = run_case(d, clock)
+    # cohort capacity 5: only one 3-cpu preemptor can ever fit even
+    # after both evictions — the second must NOT wastefully preempt b1
+    assert set(stats.preempted_targets) == {"eng-alpha/a1"}
+    assert not stats.admitted
+    ha, pa = queue_state(d, "other-alpha")
+    assert "eng-alpha/preemptor" in ha | pa
+    hb, pb = queue_state(d, "other-beta")
+    assert "eng-beta/pretending-preemptor" in hb | pb
+    assert flavors_of(d, "eng-beta/b1") == {"main": {"cpu": "default"}}
