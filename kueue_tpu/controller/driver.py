@@ -738,8 +738,11 @@ class Driver:
                         or stats.inadmissible or stats.preempting)
 
         dirty_backoff = 0
+        bstats = self._burst_solver.stats
         while len(out) < max_cycles:
             if burst_ineligible or solver is None or normal_streak > 0:
+                if normal_streak > 0 and not burst_ineligible:
+                    bstats["burst_suppressed_cycles"] += 1
                 normal_streak = max(0, normal_streak - 1)
                 if not normal_cycle() and quiescent():
                     break
@@ -750,9 +753,12 @@ class Driver:
                 # structure drifted: one snapshot rebuilds the cached
                 # tensors; steady-state re-packs skip the snapshot cost
                 st = solver._structure_for(self.cache.snapshot(), [])
+            _t_pack = time.perf_counter()
             plan = pack_burst(st, self.queues, self.cache,
                               self.scheduler, self.clock,
                               min_m=self._burst_m)
+            bstats["burst_pack_s"] += time.perf_counter() - _t_pack
+            bstats["burst_packs"] += 1
             if plan is None:
                 if not normal_cycle() and quiescent():
                     break
@@ -814,6 +820,7 @@ class Driver:
                 self.queues.wake_expired_backoffs()
                 heads = self.queues.heads_nonblocking()
                 if dirty[k]:
+                    bstats["burst_dirty_cycles"] += 1
                     normal_cycle(heads=heads, advance=False)
                     if applied == 0:
                         dirty_backoff = min(8, max(1, 2 * dirty_backoff))

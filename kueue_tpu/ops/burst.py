@@ -657,7 +657,11 @@ class BurstSolver:
         self.backend = backend
         self.stats = {"burst_dispatches": 0, "burst_cycles_decided": 0,
                       "burst_accel_dispatches": 0,
-                      "burst_dispatch_s": 0.0}
+                      "burst_dispatch_s": 0.0,
+                      # boundary + fallback visibility (VERDICT r4 item 9)
+                      "burst_pack_s": 0.0, "burst_packs": 0,
+                      "burst_suppressed_cycles": 0,
+                      "burst_dirty_cycles": 0}
 
     def _device(self):
         import jax
