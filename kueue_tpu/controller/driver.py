@@ -52,6 +52,15 @@ from ..workload import (
 from .. import metrics
 
 
+def _unpack_target_rows(words, cand_rows_g):
+    """Bit-packed candidate-slot words -> flattened row ids."""
+    import numpy as np
+    w = np.asarray(words, dtype=np.uint32)
+    set_bits = ((w[:, None] >> np.arange(32, dtype=np.uint32)) & 1) > 0
+    wi, bi = np.nonzero(set_bits)
+    return cand_rows_g[wi * 32 + bi]
+
+
 @dataclass
 class WaitForPodsReadyConfig:
     """reference apis/config/v1beta1 WaitForPodsReady (:216)."""
@@ -783,30 +792,55 @@ class Driver:
                             and _reservation_ts(key) == sched_ts.get(key)]
                     if keys:
                         sched.setdefault(due, []).extend(keys)
-            if not self._fill_ext_release(st, plan, sched, len(out), K,
-                                          ext_release, ext_unpark):
+            if not self._fill_burst_finishes(st, plan, sched, len(out), K,
+                                             ext_release, ext_unpark):
                 if not normal_cycle() and quiescent():
                     break
                 continue
-            head_row, admitted, fit_slot, borrows, _park, dirty, _ = (
+            (head_row, kind, slot, borrows, tgt_words, dirty,
+             dirty_reason, _u) = (
                 self._burst_solver.run(plan, K, runtime, ext_release,
                                        ext_unpark))
+            from ..ops import burst as _b
+            kind_name = {_b.KIND_ADMIT: "admit", _b.KIND_SKIP: "skip",
+                         _b.KIND_PARK: "park", _b.KIND_PREEMPT: "preempt",
+                         _b.KIND_RESERVE: "reserve",
+                         _b.KIND_OVERLAP_SKIP: "overlap_skip",
+                         _b.KIND_PRE_NOFIT: "pre_nofit"}
+            cand_rows = plan.arrays["cand_rows"]
+            forest_of_cq = plan.arrays["forest_of_cq"]
+            st_names = st.cq_names
             applied = 0
             drained = False
+            # candidate ordering inside the kernel assumes reservation
+            # timestamps strictly increase across applied cycles (and
+            # past every pre-burst reservation); track it and refuse to
+            # apply modeled preempt cycles if violated
+            last_adm_clock = plan.max_res_ts
+            clock_monotone = True
             for k in range(K):
                 if len(out) >= max_cycles:
                     break
                 modeled: dict = {}
+                has_pre_kind = False
                 for ci in np.nonzero(head_row[k] >= 0)[0]:
+                    ci = int(ci)
                     key = plan.keys[ci][int(head_row[k, ci])]
-                    if admitted[k, ci]:
-                        kind = "admit"
-                    elif fit_slot[k, ci] >= 0:
-                        kind = "skip"
-                    else:
-                        kind = "park"
-                    modeled[key] = (kind, int(fit_slot[k, ci]),
-                                    bool(borrows[k, ci]))
+                    kd = kind_name.get(int(kind[k, ci]), "park")
+                    targets = None
+                    if kd == "preempt":
+                        rows = _unpack_target_rows(
+                            tgt_words[k, ci], cand_rows[forest_of_cq[ci]])
+                        targets = []
+                        for r in rows:
+                            tci, tmi = divmod(int(r), plan.M)
+                            targets.append((plan.keys[tci][tmi],
+                                            st_names[tci]))
+                    if kd in ("preempt", "reserve", "overlap_skip",
+                              "pre_nofit"):
+                        has_pre_kind = True
+                    modeled[key] = (kd, int(slot[k, ci]),
+                                    bool(borrows[k, ci]), targets)
                 if not dirty[k] and not modeled and quiescent():
                     drained = True
                     break
@@ -821,11 +855,23 @@ class Driver:
                 heads = self.queues.heads_nonblocking()
                 if dirty[k]:
                     bstats["burst_dirty_cycles"] += 1
+                    r = int(dirty_reason[k])
+                    if r & _b.DIRTY_PREEMPT:
+                        bstats["burst_dirty_preempt"] += 1
+                    if r & _b.DIRTY_SCALAR:
+                        bstats["burst_dirty_scalar"] += 1
+                    if r & _b.DIRTY_RESUME:
+                        bstats["burst_dirty_resume"] += 1
                     normal_cycle(heads=heads, advance=False)
                     if applied == 0:
                         dirty_backoff = min(8, max(1, 2 * dirty_backoff))
                         normal_streak = dirty_backoff
                     break   # kernel state is stale past a host cycle
+                if has_pre_kind and not clock_monotone:
+                    # modeled candidate order may diverge from the host's
+                    # reservation-timestamp order: decide on the host
+                    normal_cycle(heads=heads, advance=False)
+                    break
                 if {h.key for h in heads} != set(modeled):
                     # unmodeled divergence: decide this cycle normally
                     normal_cycle(heads=heads, advance=False)
@@ -835,8 +881,24 @@ class Driver:
                     normal_cycle(heads=[], advance=False)
                     continue
                 stats = self.scheduler.apply_burst_cycle(heads, modeled)
+                if has_pre_kind:
+                    bstats["burst_preempt_cycles"] += 1
                 self.metrics.admission_attempt(bool(stats.admitted),
                                                stats.duration_s)
+                if stats.admitted:
+                    # the ACTUAL reservation timestamps just recorded —
+                    # a resampled clock could tick between two same-ts
+                    # admissions and hide the tie
+                    cycle_ts = [t for k2 in stats.admitted
+                                if (t := _reservation_ts(k2)) is not None]
+                    lo = min(cycle_ts, default=None)
+                    if (lo is not None and last_adm_clock is not None
+                            and lo <= last_adm_clock):
+                        clock_monotone = False
+                    hi = max(cycle_ts, default=None)
+                    if hi is not None:
+                        last_adm_clock = (hi if last_adm_clock is None
+                                          else max(last_adm_clock, hi))
                 finish_cycle(stats)
                 applied += 1
                 normal_streak = 0
@@ -845,19 +907,23 @@ class Driver:
                 break
         return out
 
-    def _fill_ext_release(self, st, plan, ext: dict, base: int, K: int,
-                          ext_release, ext_unpark) -> bool:
-        """Scale the external finish schedule into [K, C, F] release
-        tensors.  False when a release isn't representable (fall back to
-        normal cycles).  Release vectors are cached per admission (an
-        Info build + usage walk per workload is too hot for re-packs)."""
+    def _fill_burst_finishes(self, st, plan, ext: dict, base: int, K: int,
+                             ext_release, ext_unpark) -> bool:
+        """Feed the external finish schedule to the kernel: row-backed
+        workloads get their ``death0`` cycle set (the kernel releases
+        their exact usage and frees the row — preemption-aware), keys
+        without rows fall back to the aggregated [K, C, F] release
+        tensors.  False when a fallback release isn't representable
+        (run normal cycles instead).  Release vectors are cached per
+        admission (an Info build + usage walk per workload is too hot
+        for re-packs)."""
         from ..workload import Info
-        from ..api.types import WL_QUOTA_RESERVED
-        cache = getattr(self, "_release_vec_cache", None)
-        if cache is None:
-            cache = self._release_vec_cache = {}
+        from ..ops.burst import admitted_usage_vec
+        death = plan.arrays["death0"]
+        row_of_key = plan.row_of_key or {}
         scale_of = {r: int(st.resource_scale[i])
                     for i, r in enumerate(st.resource_names)}
+        F = ext_release.shape[2]
         for off, keys in ext.items():
             k = off - base
             if k < 0 or k >= K:
@@ -866,29 +932,18 @@ class Driver:
                 wl = self.workloads.get(key)
                 if wl is None or wl.admission is None:
                     continue
-                cond = wl.conditions.get(WL_QUOTA_RESERVED)
-                ts = cond.last_transition_time if cond is not None else -1
-                hit = cache.get(key)
-                if hit is not None and hit[0] == ts and hit[1] == st.generation:
-                    _, _, ci, vec = hit
-                else:
-                    ci = st.cq_index.get(wl.admission.cluster_queue)
-                    if ci is None:
-                        return False
-                    info = Info(wl, self.cache.info_options)
-                    F = ext_release.shape[2]
-                    import numpy as np
-                    vec = np.zeros(F, dtype=np.int64)
-                    for fr, v in info.usage().items():
-                        fi = st.fr_index.get(fr)
-                        if fi is None:
-                            return False
-                        s = scale_of.get(fr.resource)
-                        if s is None or v % s:
-                            return False
-                        vec[fi] += v // s
-                    cache[key] = (ts, st.generation, ci, vec)
-                ext_release[k, ci] += vec
+                loc = row_of_key.get(key)
+                if loc is not None and plan.arrays["adm0"][loc]:
+                    death[loc] = min(int(death[loc]), k)
+                    continue
+                ci = st.cq_index.get(wl.admission.cluster_queue)
+                if ci is None:
+                    return False
+                uv = admitted_usage_vec(Info(wl, self.cache.info_options),
+                                        st, scale_of, F)
+                if uv is None:
+                    return False
+                ext_release[k, ci] += uv[0]
                 ext_unpark[k, int(plan.arrays["forest_of_cq"][ci])] = True
         return True
 

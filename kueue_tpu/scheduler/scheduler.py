@@ -583,14 +583,21 @@ class Scheduler:
                           modeled: dict) -> CycleStats:
         """Apply one fused-burst cycle's decisions to the real state.
 
-        ``modeled``: {workload key: ("admit"|"skip"|"park", slot,
-        borrows)} from the burst kernel.  The caller has already
-        validated that ``heads`` matches the modeled head set exactly;
-        this applies the same mutations the normal admit loop would —
-        assume + apply for admissions, skip/park requeues — without
-        re-deciding anything (reference scheduler.go:211-284 with the
-        decisions precomputed)."""
+        ``modeled``: {workload key: (kind, slot, borrows, targets)} from
+        the burst kernel, where kind ∈ "admit"|"skip"|"park"|"preempt"|
+        "reserve"|"overlap_skip"|"pre_nofit" and ``targets`` (preempt
+        only) is [(target key, target cq name), ...].  The caller has
+        already validated that ``heads`` matches the modeled head set
+        exactly; this applies the same mutations the normal admit loop
+        would — assume + apply for admissions, eviction issuance for
+        preemptions, skip/park/reserve requeues — without re-deciding
+        anything (reference scheduler.go:211-284 with the decisions
+        precomputed)."""
         from ..ops.solver import build_slot_assignment
+        from ..api.types import (
+            IN_CLUSTER_QUEUE_REASON,
+            IN_COHORT_RECLAMATION_REASON,
+        )
         self.scheduling_cycle += 1
         stats = CycleStats(cycle=self.scheduling_cycle)
         start = self.clock()
@@ -599,7 +606,7 @@ class Scheduler:
                 f"{info.obj.namespace}/{info.obj.queue_name}")
             info.cluster_queue = lq.cluster_queue if lq else ""
             e = Entry(info=info)
-            kind, slot, borrows = modeled[info.key]
+            kind, slot, borrows, targets = modeled[info.key]
             cq = self.cache.cluster_queue(info.cluster_queue)
             if kind == "admit":
                 e.assignment = build_slot_assignment(
@@ -624,6 +631,61 @@ class Scheduler:
                 self._set_skipped(e, "Workload no longer fits after "
                                      "processing another workload")
                 stats.skipped.append(info.key)
+            elif kind == "preempt":
+                # in-kernel preemption winner: issue the evictions
+                # (scheduler.go:176-284 preempt branch; targets were
+                # selected by the kernel's greedy+fillback search)
+                e.assignment = build_slot_assignment(
+                    info, cq, slot, Mode.PREEMPT, borrows)
+                e.inadmissible_msg = e.assignment.message()
+                e.info.last_assignment = None
+                tgt_objs = []
+                for tkey, tcq_name in targets:
+                    t_info = self._live_admitted_info(tcq_name, tkey)
+                    if t_info is None:
+                        continue
+                    reason = (IN_CLUSTER_QUEUE_REASON
+                              if tcq_name == info.cluster_queue
+                              else IN_COHORT_RECLAMATION_REASON)
+                    tgt_objs.append(Target(info=t_info, reason=reason))
+                preempted = self.preemptor.issue_preemptions(e.info,
+                                                             tgt_objs)
+                if preempted:
+                    e.inadmissible_msg += (
+                        f". Pending the preemption of {preempted} "
+                        f"workload(s)")
+                    e.requeue_reason = RequeueReason.PENDING_PREEMPTION
+                stats.preempting.append(info.key)
+                stats.preempted_targets.extend(t.info.key
+                                               for t in tgt_objs)
+                # the entry itself requeues un-assumed: the host cycle
+                # counts it inadmissible as well (scheduler.py loop tail)
+                stats.inadmissible.append(info.key)
+            elif kind == "reserve":
+                # preempt-classified, no targets: capacity was reserved
+                # in-kernel; the entry requeues not-nominated
+                e.assignment = build_slot_assignment(
+                    info, cq, slot, Mode.PREEMPT, borrows)
+                e.info.last_assignment = e.assignment.last_state
+                e.inadmissible_msg = e.assignment.message()
+                stats.inadmissible.append(info.key)
+            elif kind == "overlap_skip":
+                e.assignment = build_slot_assignment(
+                    info, cq, slot, Mode.PREEMPT, borrows)
+                e.info.last_assignment = e.assignment.last_state
+                self._set_skipped(e, "Workload has overlapping "
+                                     "preemption targets with another "
+                                     "workload")
+                if self.metrics is not None:
+                    self.metrics.cycle_preemption_skip()
+                stats.skipped.append(info.key)
+            elif kind == "pre_nofit":
+                e.assignment = build_slot_assignment(
+                    info, cq, slot, Mode.PREEMPT, borrows)
+                e.info.last_assignment = e.assignment.last_state
+                self._set_skipped(e, "Workload no longer fits after "
+                                     "processing another workload")
+                stats.skipped.append(info.key)
             else:  # park: NoFit at nominate (BestEffortFIFO parks it)
                 e.info.last_assignment = None
                 e.inadmissible_msg = ("couldn't assign flavors to pod "
@@ -632,6 +694,13 @@ class Scheduler:
             self._requeue_and_update(e)
         stats.duration_s = self.clock() - start
         return stats
+
+    def _live_admitted_info(self, cq_name: str, key: str) -> Optional[Info]:
+        """The live cache Info of an admitted workload (eviction target)."""
+        cq = self.cache.cluster_queue(cq_name)
+        if cq is None:
+            return None
+        return cq.workloads.get(key)
 
     @staticmethod
     def _has_retry_or_rejected_checks(wl: Workload) -> bool:
