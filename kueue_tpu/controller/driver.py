@@ -307,9 +307,6 @@ class Driver:
             if wl is None or wl.is_finished:
                 continue
             set_finished_condition(wl, "JobFinished", message, now)
-            rcache = getattr(self, "_release_vec_cache", None)
-            if rcache is not None:
-                rcache.pop(key, None)
             if wl.admission is not None:
                 cq_name = wl.admission.cluster_queue
                 was_admitted = wl.is_admitted
@@ -344,11 +341,9 @@ class Driver:
                 changed = True
         if not changed:
             return
-        # the admitted usage shrinks: any cached burst release vector
-        # for this workload is stale
-        cache = getattr(self, "_release_vec_cache", None)
-        if cache is not None:
-            cache.pop(key, None)
+        # the admitted usage shrinks; the fresh Info below replaces the
+        # cached one in the cache CQ, so per-Info burst usage vectors
+        # (ops/burst.py admitted_usage_vec) can never go stale
         wl.reclaimable_pods = [ReclaimablePod(name=n, count=c)
                                for n, c in sorted(existing.items())]
         if wl.admission is not None:
@@ -762,10 +757,13 @@ class Driver:
                 # structure drifted: one snapshot rebuilds the cached
                 # tensors; steady-state re-packs skip the snapshot cost
                 st = solver._structure_for(self.cache.snapshot(), [])
+            remaining = max_cycles - len(out)
+            K = next((r for r in K_BURST_LADDER if r >= min(
+                remaining, K_BURST_LADDER[-1])), K_BURST_LADDER[-1])
             _t_pack = time.perf_counter()
             plan = pack_burst(st, self.queues, self.cache,
                               self.scheduler, self.clock,
-                              min_m=self._burst_m)
+                              min_m=self._burst_m, window=K)
             bstats["burst_pack_s"] += time.perf_counter() - _t_pack
             bstats["burst_packs"] += 1
             if plan is None:
@@ -773,9 +771,6 @@ class Driver:
                     break
                 continue
             self._burst_m = max(self._burst_m, plan.M)
-            remaining = max_cycles - len(out)
-            K = next((r for r in K_BURST_LADDER if r >= min(
-                remaining, K_BURST_LADDER[-1])), K_BURST_LADDER[-1])
             F = max(1, len(st.fr_index))
             ext_release = np.zeros((K, plan.C, F), dtype=np.int32)
             ext_unpark = np.zeros((K, plan.G), dtype=bool)
@@ -939,8 +934,16 @@ class Driver:
                 ci = st.cq_index.get(wl.admission.cluster_queue)
                 if ci is None:
                     return False
-                uv = admitted_usage_vec(Info(wl, self.cache.info_options),
-                                        st, scale_of, F)
+                # the live cache Info carries the per-Info usage cache
+                # (a throwaway Info would rebuild the usage walk every
+                # re-pack)
+                cq_live = self.cache.cluster_queue(
+                    wl.admission.cluster_queue)
+                info = (cq_live.workloads.get(key)
+                        if cq_live is not None else None)
+                if info is None:
+                    info = Info(wl, self.cache.info_options)
+                uv = admitted_usage_vec(info, st, scale_of, F)
                 if uv is None:
                     return False
                 ext_release[k, ci] += uv[0]

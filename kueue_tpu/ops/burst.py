@@ -861,10 +861,12 @@ def build_candidate_tables(forest_of_cq: np.ndarray, members: np.ndarray,
     return cand_rows, cand_lmem, self_lmem
 
 
-def _static_row(info, st, covers_pods: bool):
+def _static_row(info, st, covers_pods: bool, qts):
     """Per-Info static pack facts: (covers_pods, scaled request vector,
-    static vectorized-eligibility).  Cached on the Info keyed by the
-    structure generation — total_requests are immutable per Info."""
+    static vectorized-eligibility, queue-order ts, priority, uid).
+    Cached on the Info keyed by the structure generation — requests,
+    conditions, and priority are immutable per Info instance (updates
+    build a fresh Info — queue/manager.py add_or_update_workload)."""
     R = len(st.resource_names)
     scale = st.resource_scale
     obj = info.obj
@@ -897,7 +899,8 @@ def _static_row(info, st, covers_pods: bool):
     if acc.max(initial=0) > I32_MAX:
         exact = False
         np.clip(acc, None, I32_MAX, out=acc)
-    return covers_pods, acc.astype(np.int32), ok and exact
+    return (covers_pods, acc.astype(np.int32), ok and exact,
+            qts(obj), obj.priority, obj.uid)
 
 
 KC_CAP = 4096          # max candidate slots per forest (in-kernel preempt)
@@ -936,7 +939,7 @@ def admitted_usage_vec(info, st, scale_of: dict, F: int) -> Optional[tuple]:
 
 
 def pack_burst(structure, queues, cache, scheduler, clock,
-               min_m: int = 0) -> Optional[BurstPlan]:
+               min_m: int = 0, window: int = 0) -> Optional[BurstPlan]:
     """Build the dense [C, M] state from the live queues + cache.
 
     Rows cover BOTH pending workloads (heap + parking lot) and admitted
@@ -946,7 +949,14 @@ def pack_burst(structure, queues, cache, scheduler, clock,
     limitations never fail the pack — they mark the row ``vec_ok=False``
     (pending) or gate the forest out of the in-kernel preemption
     envelope (admitted), so the affected cycles go dirty and run on the
-    normal host path instead."""
+    normal host path instead.
+
+    ``window`` > 0 bounds the dispatch's cycle count: only the
+    ``window + 2`` best-ranked pending rows per CQ are packed (plus all
+    admitted rows).  Sound because at most one row per CQ leaves the
+    eligible set per cycle, so a row below the cutoff cannot become a
+    head within the window; any modeling miss is caught by the driver's
+    per-cycle heads validation (truncate + repack)."""
     st = structure
     C = len(st.cq_names)
     F = max(1, len(st.fr_index))
@@ -985,6 +995,23 @@ def pack_burst(structure, queues, cache, scheduler, clock,
                 continue
             members_by_ci[ci].append(info)
             parked_by_ci[ci].add(info.key)
+
+    if window > 0:
+        import heapq
+        cap = window + 2
+        qts_sel = ordering.queue_order_timestamp
+
+        def sel_key(info):
+            row = getattr(info, "_burst_row", None)
+            if row is not None and row[0] == st.generation:
+                return (-row[5], row[4], info.key)
+            obj = info.obj
+            return (-obj.priority, qts_sel(obj), info.key)
+
+        for ci in range(C):
+            if len(members_by_ci[ci]) > cap:
+                members_by_ci[ci] = heapq.nsmallest(
+                    cap, members_by_ci[ci], key=sel_key)
 
     n_pending = sum(len(m) for m in members_by_ci)
     if n_pending == 0:
@@ -1054,17 +1081,20 @@ def pack_burst(structure, queues, cache, scheduler, clock,
     n_upper = n_pending + sum(len(a) for a in admitted_by_ci)
     # list appends + one bulk conversion: per-element numpy scalar
     # writes cost ~0.3us each and dominate the 100k-row pack
-    ci_l: list[int] = []
     prio_l: list[int] = []
     ts_l: list[float] = []
-    pos_l: list[int] = []
     parked_l: list[bool] = []
-    adm_l: list[bool] = []
-    res_ts_l: list[float] = []
+    adm_res_ts_l: list[float] = []    # per admitted row, in row order
     ok_l: list[bool] = []
     resume_l: list[bool] = []
     key_a: list[str] = []
     uid_a: list[str] = []
+    # per-CQ segments: (ci, pos, n_pending_rows, n_admitted_rows) —
+    # per-row constants come from np.repeat instead of per-row appends
+    seg_ci: list[int] = []
+    seg_pos: list[int] = []
+    seg_np: list[int] = []
+    seg_na: list[int] = []
     req_mat = np.zeros((n_upper, R), dtype=np.int32)
     usage_mat = np.zeros((n_upper, F), dtype=np.int32)
     uses_mat = np.zeros((n_upper, F), dtype=bool)
@@ -1077,6 +1107,7 @@ def pack_burst(structure, queues, cache, scheduler, clock,
         alist = admitted_by_ci[ci]
         if not mlist and not alist:
             continue
+        i_seg = i
         cq_name = st.cq_names[ci]
         cq_live = cache.cluster_queue(cq_name)
         covers_pods = cq_name in st.cq_covers_pods
@@ -1089,32 +1120,29 @@ def pack_burst(structure, queues, cache, scheduler, clock,
                        if cq_live is not None else -1)
         pk = parked_by_ci[ci]
         for info in mlist:
-            obj = info.obj
             row = getattr(info, "_burst_row", None)
             if row is None or row[0] != gen or row[1] != covers_pods:
-                row = (gen, *_static_row(info, st, covers_pods))
+                row = (gen, *_static_row(info, st, covers_pods, qts))
                 info._burst_row = row
-            _, _, req_vec, static_ok = row
+            _, _, req_vec, static_ok, ts, prio, uid = row
             key = info.key
             key_a.append(key)
-            uid_a.append(obj.uid)
-            ci_l.append(ci)
-            prio_l.append(obj.priority)
-            ts_l.append(qts(obj))
-            pos_l.append(pos)
+            uid_a.append(uid)
+            prio_l.append(prio)
+            ts_l.append(ts)
             parked_l.append(key in pk)
-            adm_l.append(False)
-            res_ts_l.append(0.0)
             req_mat[i] = req_vec
             ok = cq_vec and static_ok
-            if ok and lr_summaries and lr_summaries.get(obj.namespace):
-                ok = False   # LimitRange bounds stay on the host path
-            if ok and (key in assumed or obj.admission is not None):
-                ok = False
-            if ok and obj.admission_check_states:
-                if any(stt.state in (AdmissionCheckState.RETRY,
-                                     AdmissionCheckState.REJECTED)
-                       for stt in obj.admission_check_states.values()):
+            if ok:
+                obj = info.obj
+                if lr_summaries and lr_summaries.get(obj.namespace):
+                    ok = False   # LimitRange bounds stay host-side
+                elif key in assumed or obj.admission is not None:
+                    ok = False
+                elif obj.admission_check_states and any(
+                        stt.state in (AdmissionCheckState.RETRY,
+                                      AdmissionCheckState.REJECTED)
+                        for stt in obj.admission_check_states.values()):
                     ok = False
             ok_l.append(ok)
             last = info.last_assignment
@@ -1124,12 +1152,11 @@ def pack_burst(structure, queues, cache, scheduler, clock,
                 and last.cluster_queue_generation >= allocatable)
             i += 1
         for info in alist:
-            obj = info.obj
             row = getattr(info, "_burst_row", None)
             if row is None or row[0] != gen or row[1] != covers_pods:
-                row = (gen, *_static_row(info, st, covers_pods))
+                row = (gen, *_static_row(info, st, covers_pods, qts))
                 info._burst_row = row
-            _, _, req_vec, static_ok = row
+            _, _, req_vec, static_ok, ts, prio, uid = row
             uv = usage_vec(info)
             if uv is None:
                 # not representable as a target/release row: the host
@@ -1138,28 +1165,51 @@ def pack_burst(structure, queues, cache, scheduler, clock,
                 forest_bad[int(forest_of_cq[ci])] = True
                 continue
             key_a.append(info.key)
-            uid_a.append(obj.uid)
-            ci_l.append(ci)
-            prio_l.append(obj.priority)
-            ts_l.append(qts(obj))
-            pos_l.append(pos)
+            uid_a.append(uid)
+            prio_l.append(prio)
+            ts_l.append(ts)
             parked_l.append(False)
-            adm_l.append(True)
+            obj = info.obj
             cond = obj.conditions.get(WL_QUOTA_RESERVED)
-            res_ts_l.append(cond.last_transition_time)
+            adm_res_ts_l.append(cond.last_transition_time)
             req_mat[i] = req_vec
             usage_mat[i], uses_mat[i] = uv
-            ok_l.append(cq_vec and static_ok)  # post-eviction afterlife
+            # post-eviction afterlife: the same dynamic gates pending
+            # rows get (LimitRange bounds, failed admission checks) —
+            # an in-burst-evicted row the kernel re-admits must honor
+            # everything the host nominate would; gating extra is safe
+            # (the cycle goes dirty), gating less diverges decisions
+            ok = cq_vec and static_ok
+            if ok:
+                if lr_summaries and lr_summaries.get(obj.namespace):
+                    ok = False
+                elif obj.admission_check_states and any(
+                        stt.state in (AdmissionCheckState.RETRY,
+                                      AdmissionCheckState.REJECTED)
+                        for stt in obj.admission_check_states.values()):
+                    ok = False
+            ok_l.append(ok)
             resume_l.append(False)
             i += 1
+        seg_ci.append(ci)
+        seg_pos.append(pos)
+        seg_np.append(len(mlist))
+        seg_na.append(i - i_seg - len(mlist))
     n = i
-    ci_a = np.array(ci_l, dtype=np.int32)
+    seg_np_a = np.array(seg_np, dtype=np.int64)
+    seg_na_a = np.array(seg_na, dtype=np.int64)
+    seg_rows = seg_np_a + seg_na_a
+    ci_a = np.repeat(np.array(seg_ci, dtype=np.int32), seg_rows)
+    pos_a = np.repeat(np.array(seg_pos, dtype=np.int32), seg_rows)
+    flags = np.zeros(2 * len(seg_ci), dtype=bool)
+    flags[1::2] = True   # each CQ: pending rows then admitted rows
+    adm_a = np.repeat(
+        flags, np.stack([seg_np_a, seg_na_a], axis=1).reshape(-1))
     prio_a = np.array(prio_l, dtype=np.int64)
     ts_a = np.array(ts_l, dtype=np.float64)
-    pos_a = np.array(pos_l, dtype=np.int32)
     parked_a = np.array(parked_l, dtype=bool)
-    adm_a = np.array(adm_l, dtype=bool)
-    res_ts_a = np.array(res_ts_l, dtype=np.float64)
+    res_ts_a = np.zeros(n, dtype=np.float64)
+    res_ts_a[adm_a] = np.array(adm_res_ts_l, dtype=np.float64)
     ok_a = np.array(ok_l, dtype=bool)
     resume_a = np.array(resume_l, dtype=bool)
     req_mat = req_mat[:n]

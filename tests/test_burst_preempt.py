@@ -418,3 +418,32 @@ def test_two_resources_partial_preempt_need():
     kernel_decided(db)
     preempted = {k for s in burst for k in s.preempted_targets}
     assert preempted == {"default/low"}
+
+
+def test_evicted_row_afterlife_honors_limit_range():
+    """An in-burst-evicted workload whose namespace gained a LimitRange
+    after its original admission must NOT be re-admitted by the kernel:
+    its afterlife row is gated out of the vectorized envelope and the
+    host path (which rules it inadmissible) decides — the r5 review
+    repro (pack ok_l for admitted rows skipping the LimitRange gate)."""
+    from kueue_tpu.limitrange import LimitRange, LimitRangeItem
+
+    def spec(d):
+        simple_cluster(n_cohorts=1, cqs=1, nominal=4000,
+                       preemption=PRE_ANY)(d)
+
+    def prelude(d, clock):
+        d.create_workload(mk("victim", "lq-0-0", 4000, prio=0, t=1.0))
+        clock.t += 1.0
+        d.schedule_once()          # victim admitted pre-LimitRange
+        d.apply_limit_range(LimitRange(
+            name="lr", namespace="default",
+            items=[LimitRangeItem(type="Container",
+                                  max={"cpu": 3500})]))
+        d.create_workload(mk("boss", "lq-0-0", 3000, prio=100, t=50.0))
+
+    da, db, burst = run_pair(spec, prelude, cycles=8, runtime=2)
+    assert any("default/victim" in s.preempted_targets for s in burst)
+    # after eviction the 4000-cpu victim exceeds the namespace max of
+    # 3500: never re-admitted on either path
+    assert "default/victim" not in {k for s in burst for k in s.admitted}

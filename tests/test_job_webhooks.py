@@ -388,3 +388,107 @@ def test_deployment_queue_freeze_on_ready_pods():
     removed = Deployment("serve", replicas=2, requests={"cpu": 100})
     with pytest.raises(ValidationError):
         validate_job_update(old, removed)
+
+
+# -- kubeflow runPolicy / priority precedence / TAS tables ---------------
+# (reference kubeflowjob_controller.go:48-170, mpijob_webhook.go:125-135)
+
+
+def test_kubeflow_priority_class_precedence_scheduling_policy_wins():
+    from kueue_tpu.jobs.kubeflow import (MPIJob, RunPolicy,
+                                         SchedulingPolicy)
+    job = MPIJob("m", replicas=[
+        ReplicaSpec(role="Launcher", replicas=1, requests={"cpu": 100},
+                    priority_class_name="launcher-prio"),
+        ReplicaSpec(role="Worker", replicas=2, requests={"cpu": 100},
+                    priority_class_name="worker-prio"),
+    ], run_policy=RunPolicy(scheduling_policy=SchedulingPolicy(
+        priority_class="sched-prio")), queue="lq")
+    assert job.priority_class_name == "sched-prio"
+
+
+def test_kubeflow_priority_class_precedence_first_ordered_replica():
+    from kueue_tpu.jobs.kubeflow import MPIJob
+    # no scheduling policy: the FIRST ordered replica's template
+    # priorityClassName wins (Launcher before Worker), regardless of
+    # declaration order
+    job = MPIJob("m", replicas=[
+        ReplicaSpec(role="Worker", replicas=2, requests={"cpu": 100},
+                    priority_class_name="worker-prio"),
+        ReplicaSpec(role="Launcher", replicas=1, requests={"cpu": 100},
+                    priority_class_name="launcher-prio"),
+    ], queue="lq")
+    assert job.priority_class_name == "launcher-prio"
+
+
+def test_kubeflow_priority_class_precedence_falls_through_to_worker():
+    from kueue_tpu.jobs.kubeflow import PyTorchJob as PT
+    job = PT("p", replicas=[
+        ReplicaSpec(role="Master", replicas=1, requests={"cpu": 100}),
+        ReplicaSpec(role="Worker", replicas=2, requests={"cpu": 100},
+                    priority_class_name="worker-prio"),
+    ], queue="lq")
+    assert job.priority_class_name == "worker-prio"
+
+
+def test_kubeflow_run_policy_suspend_round_trip():
+    from kueue_tpu.jobs.kubeflow import PyTorchJob as PT
+    d = make_driver()
+    m = JobManager(d)
+    job = PT("rp", replicas=[
+        ReplicaSpec(role="Worker", replicas=1, requests={"cpu": 100}),
+    ], queue="lq")
+    assert job.run_policy.suspend and job.is_suspended()
+    m.upsert(job)
+    m.run()
+    assert not job.is_suspended()
+    assert job.run_policy.suspend is False   # unsuspend rides runPolicy
+    job.suspend()
+    assert job.run_policy.suspend is True
+
+
+def test_kubeflow_pods_ready_and_active_via_status():
+    from kueue_tpu.jobs.kubeflow import TFJob as TF
+    job = TF("st", replicas=[
+        ReplicaSpec(role="Chief", replicas=1, requests={"cpu": 100}),
+        ReplicaSpec(role="Worker", replicas=2, requests={"cpu": 100}),
+    ], queue="lq")
+    assert not job.pods_ready() and not job.is_active()
+    job.mark_running()
+    assert job.pods_ready() and job.is_active()
+    assert job.replica_statuses["Worker"].active == 2
+    job.mark_succeeded()
+    assert not job.pods_ready()
+    _, success, finished = job.finished()
+    assert success and finished
+
+
+def test_mpijob_invalid_topology_request_rejected_sorted():
+    from kueue_tpu.api.types import PodSetTopologyRequest as TopologyRequest
+    from kueue_tpu.jobs.kubeflow import MPIJob
+    job = MPIJob("topo", replicas=[
+        ReplicaSpec(role="Launcher", replicas=1, requests={"cpu": 100},
+                    topology_request=TopologyRequest(
+                        required="not a label!!")),
+        ReplicaSpec(role="Worker", replicas=2, requests={"cpu": 100},
+                    topology_request=TopologyRequest(
+                        required="cloud/rack", preferred="cloud/rack")),
+    ], queue="lq")
+    errors = job.validate_on_create()
+    assert any("not a valid label name" in e for e in errors)
+    assert any("more than one topology annotation" in e for e in errors)
+    # errors sorted by field path (mpijob_webhook.go:131-134)
+    topo = [e for e in errors if "template.metadata" in e]
+    assert topo == sorted(topo)
+
+
+def test_mpijob_valid_topology_request_admitted():
+    from kueue_tpu.api.types import PodSetTopologyRequest as TopologyRequest
+    from kueue_tpu.jobs.kubeflow import MPIJob
+    job = MPIJob("topo-ok", replicas=[
+        ReplicaSpec(role="Launcher", replicas=1, requests={"cpu": 100},
+                    topology_request=TopologyRequest(
+                        required="cloud.google.com/rack")),
+        ReplicaSpec(role="Worker", replicas=2, requests={"cpu": 100}),
+    ], queue="lq")
+    assert job.validate_on_create() == []
