@@ -60,3 +60,34 @@ def enable(cache_dir: str | None = None,
         return None
     _enabled_dir = d
     return d
+
+
+def load_json(name: str, cache_dir: str | None = None):
+    """Read a sidecar JSON artifact (e.g. the router calibration table)
+    from the compile-cache directory; None when absent/disabled."""
+    import json
+    d = cache_dir or enable()
+    if d is None:
+        return None
+    try:
+        with open(os.path.join(d, name)) as f:
+            return json.load(f)
+    except (OSError, ValueError):
+        return None
+
+
+def save_json(name: str, obj, cache_dir: str | None = None) -> bool:
+    """Write a sidecar JSON artifact next to the compile cache
+    (atomic rename; best effort)."""
+    import json
+    d = cache_dir or enable()
+    if d is None:
+        return False
+    try:
+        tmp = os.path.join(d, f".{name}.tmp.{os.getpid()}")
+        with open(tmp, "w") as f:
+            json.dump(obj, f)
+        os.replace(tmp, os.path.join(d, name))
+        return True
+    except OSError:
+        return False

@@ -1371,7 +1371,10 @@ def pack_burst(structure, queues, cache, scheduler, clock,
                                  if adm_a.any() else None))
 
 
-K_BURST_LADDER = (8, 32, 64)
+# one K rung: every distinct K is a full kernel compilation, and a
+# 32-cycle window amortizes the dispatch while deciding a few unused
+# cycles at most ~15ms of kernel time when fewer remain
+K_BURST_LADDER = (32,)
 
 
 class BurstSolver:
