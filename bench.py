@@ -217,6 +217,11 @@ def one_trial(scale: float):
 
 
 def main():
+    if ("--require-accel" in sys.argv[1:]
+            or os.environ.get("KUEUE_TPU_REQUIRE_ACCEL", "0")
+            not in ("", "0")):
+        from kueue_tpu.perf.harness import require_accel_or_die
+        require_accel_or_die()
     scale = float(os.environ.get("BENCH_SCALE", "1.0"))
     # N trials, median by throughput, min/max spread reported — the
     # reference rangespec's ±band discipline (default_rangespec.yaml:1-6)

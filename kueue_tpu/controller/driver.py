@@ -630,7 +630,8 @@ class Driver:
                        external_finishes: Optional[dict] = None,
                        on_cycle: Optional[Callable] = None,
                        on_cycle_start: Optional[Callable] = None,
-                       backend: str = "auto") -> list:
+                       backend: str = "auto",
+                       pipeline: Optional[bool] = None) -> list:
         """Run up to ``max_cycles`` cycles, fusing runs of clean cycles
         into single device dispatches (kueue_tpu.ops.burst) and falling
         back to the normal per-cycle path whenever a cycle needs host
@@ -646,7 +647,22 @@ class Driver:
         itself.  ``on_cycle_start(k)`` / ``on_cycle(k, stats)`` bracket
         each applied cycle (clock advancement, bookkeeping).
 
+        ``pipeline`` (default on; KUEUE_BURST_PIPELINE=0 disables)
+        double-buffers the burst boundary: after a window with no
+        modeled-dirty cycle is fetched, the NEXT window is dispatched
+        speculatively off the kernel's final carry — device-resident,
+        no host re-pack — before this window's apply loop starts, so
+        pack+dispatch overlap apply instead of landing serially in one
+        cycle.  A speculative window is only ever consumed when every
+        cycle of the window it chained from applied exactly as modeled
+        and the structure generation is unchanged; anything else
+        (dirty truncation, heads divergence, clock-order violation,
+        vanished preempt target, structure drift) discards it unused
+        and the serial pack path decides — decisions are bit-identical
+        to pipeline-off by construction.
+
         Returns the list of per-cycle CycleStats actually applied."""
+        import os
         import numpy as np
         from ..ops.burst import BurstSolver, pack_burst, K_BURST_LADDER
 
@@ -743,8 +759,22 @@ class Driver:
 
         dirty_backoff = 0
         bstats = self._burst_solver.stats
+        if pipeline is None:
+            pipeline = os.environ.get("KUEUE_BURST_PIPELINE", "1") != "0"
+        spec = None          # speculative BurstHandle for the next window
+        last_adm_clock = None
+        clock_monotone = True
+
+        def cancel_spec(h):
+            """Discard an in-flight speculative window unfetched — its
+            assumptions were invalidated; it must never be applied."""
+            if h is not None:
+                bstats["burst_spec_cancelled"] += 1
+            return None
+
         while len(out) < max_cycles:
             if burst_ineligible or solver is None or normal_streak > 0:
+                spec = cancel_spec(spec)
                 if normal_streak > 0 and not burst_ineligible:
                     bstats["burst_suppressed_cycles"] += 1
                 normal_streak = max(0, normal_streak - 1)
@@ -757,45 +787,81 @@ class Driver:
                 # structure drifted: one snapshot rebuilds the cached
                 # tensors; steady-state re-packs skip the snapshot cost
                 st = solver._structure_for(self.cache.snapshot(), [])
+                spec = cancel_spec(spec)
             remaining = max_cycles - len(out)
-            K = next((r for r in K_BURST_LADDER if r >= min(
-                remaining, K_BURST_LADDER[-1])), K_BURST_LADDER[-1])
-            _t_pack = time.perf_counter()
-            plan = pack_burst(st, self.queues, self.cache,
-                              self.scheduler, self.clock,
-                              min_m=self._burst_m, window=K)
-            bstats["burst_pack_s"] += time.perf_counter() - _t_pack
-            bstats["burst_packs"] += 1
-            if plan is None:
-                if not normal_cycle() and quiescent():
-                    break
-                continue
-            self._burst_m = max(self._burst_m, plan.M)
-            F = max(1, len(st.fr_index))
-            ext_release = np.zeros((K, plan.C, F), dtype=np.int32)
-            ext_unpark = np.zeros((K, plan.G), dtype=bool)
-            # the kernel must model EVERY release during its window: the
-            # caller's external schedule plus the still-pending modeled
-            # finishes of cycles applied earlier in this call (a re-pack
-            # after truncation starts a fresh release ring)
-            sched = {k: list(v) for k, v in ext.items()}
-            if runtime > 0:
-                for j in range(max(0, len(out) - runtime), len(out)):
-                    due = j + runtime
-                    keys = [key for key in out[j].admitted
-                            if _reservation_ts(key) is not None
-                            and _reservation_ts(key) == sched_ts.get(key)]
-                    if keys:
-                        sched.setdefault(due, []).extend(keys)
-            if not self._fill_burst_finishes(st, plan, sched, len(out), K,
-                                             ext_release, ext_unpark):
-                if not normal_cycle() and quiescent():
-                    break
-                continue
+            if spec is not None:
+                # pipelined boundary: this window's pack+dispatch
+                # already ran, overlapped with the previous apply loop
+                handle, spec = spec, None
+                plan, K = handle.plan, handle.K
+                st = plan.structure
+                bstats["burst_overlapped_packs"] += 1
+            else:
+                K = next((r for r in K_BURST_LADDER if r >= min(
+                    remaining, K_BURST_LADDER[-1])), K_BURST_LADDER[-1])
+                _t_pack = time.perf_counter()
+                plan = pack_burst(st, self.queues, self.cache,
+                                  self.scheduler, self.clock,
+                                  min_m=self._burst_m, window=K)
+                bstats["burst_pack_s"] += time.perf_counter() - _t_pack
+                bstats["burst_packs"] += 1
+                if plan is None:
+                    if not normal_cycle() and quiescent():
+                        break
+                    continue
+                self._burst_m = max(self._burst_m, plan.M)
+                F = max(1, len(st.fr_index))
+                ext_release = np.zeros((K, plan.C, F), dtype=np.int32)
+                ext_unpark = np.zeros((K, plan.G), dtype=bool)
+                # the kernel must model EVERY release during its window:
+                # the caller's external schedule plus the still-pending
+                # modeled finishes of cycles applied earlier in this
+                # call (a re-pack after truncation starts a fresh
+                # release ring)
+                sched = {k: list(v) for k, v in ext.items()}
+                if runtime > 0:
+                    for j in range(max(0, len(out) - runtime), len(out)):
+                        due = j + runtime
+                        keys = [key for key in out[j].admitted
+                                if _reservation_ts(key) is not None
+                                and _reservation_ts(key)
+                                == sched_ts.get(key)]
+                        if keys:
+                            sched.setdefault(due, []).extend(keys)
+                if not self._fill_burst_finishes(st, plan, sched,
+                                                 len(out), K,
+                                                 ext_release, ext_unpark):
+                    if not normal_cycle() and quiescent():
+                        break
+                    continue
+                handle = self._burst_solver.dispatch(
+                    plan, K, runtime, ext_release, ext_unpark)
+                # a fresh pack re-read the live reservation timestamps;
+                # candidate ordering inside the kernel assumes they
+                # strictly increase across applied cycles (and past
+                # every pre-burst reservation) — track it and refuse to
+                # apply modeled preempt cycles if violated
+                last_adm_clock = plan.max_res_ts
+                clock_monotone = True
             (head_row, kind, slot, borrows, tgt_words, dirty,
-             dirty_reason, _u) = (
-                self._burst_solver.run(plan, K, runtime, ext_release,
-                                       ext_unpark))
+             dirty_reason) = self._burst_solver.fetch(handle)
+            base = len(out)
+            # two-slot pipeline: chain the NEXT window off this one's
+            # final carry before applying, so its kernel computes while
+            # the host applies this window.  Only windows whose model is
+            # fully clean can seed a chain, and finish events the carry
+            # cannot represent force the serial path: external finishes
+            # inside or past the next window, or runtime > K (a PRE-pack
+            # admission's finish could then land past this window — the
+            # carry only models finishes of in-kernel admissions).
+            if (pipeline and remaining > K and runtime <= K
+                    and not bool(np.asarray(dirty).any())
+                    and not any(off >= base + K for off in ext)):
+                F = max(1, len(st.fr_index))
+                spec = self._burst_solver.dispatch_next(
+                    handle,
+                    np.zeros((K, plan.C, F), dtype=np.int32),
+                    np.zeros((K, plan.G), dtype=bool))
             from ..ops import burst as _b
             kind_name = {_b.KIND_ADMIT: "admit", _b.KIND_SKIP: "skip",
                          _b.KIND_PARK: "park", _b.KIND_PREEMPT: "preempt",
@@ -807,12 +873,7 @@ class Driver:
             st_names = st.cq_names
             applied = 0
             drained = False
-            # candidate ordering inside the kernel assumes reservation
-            # timestamps strictly increase across applied cycles (and
-            # past every pre-burst reservation); track it and refuse to
-            # apply modeled preempt cycles if violated
-            last_adm_clock = plan.max_res_ts
-            clock_monotone = True
+            window_complete = False
             for k in range(K):
                 if len(out) >= max_cycles:
                     break
@@ -876,6 +937,13 @@ class Driver:
                     normal_cycle(heads=[], advance=False)
                     continue
                 stats = self.scheduler.apply_burst_cycle(heads, modeled)
+                if stats is None:
+                    # a modeled preempt target has no live admitted
+                    # counterpart: the model and the real state diverged
+                    # — abandon the window and re-decide on the host
+                    bstats["burst_target_divergences"] += 1
+                    normal_cycle(heads=heads, advance=False)
+                    break
                 if has_pre_kind:
                     bstats["burst_preempt_cycles"] += 1
                 self.metrics.admission_attempt(bool(stats.admitted),
@@ -890,6 +958,12 @@ class Driver:
                     if (lo is not None and last_adm_clock is not None
                             and lo <= last_adm_clock):
                         clock_monotone = False
+                    if len(set(cycle_ts)) > 1:
+                        # >1 distinct timestamp inside ONE cycle: the
+                        # clock ticked mid-admission, so modeled preempt
+                        # ordering can no longer mirror the host's
+                        # candidatesOrdering tie-break
+                        clock_monotone = False
                     hi = max(cycle_ts, default=None)
                     if hi is not None:
                         last_adm_clock = (hi if last_adm_clock is None
@@ -898,8 +972,17 @@ class Driver:
                 applied += 1
                 normal_streak = 0
                 dirty_backoff = 0
+            else:
+                window_complete = True
+            if spec is not None and not window_complete:
+                # the window was truncated (dirty / divergence / clock):
+                # live state no longer matches the carry the speculative
+                # window chained from — it must never be applied
+                spec = cancel_spec(spec)
             if drained:
+                spec = cancel_spec(spec)
                 break
+        spec = cancel_spec(spec)
         return out
 
     def _fill_burst_finishes(self, st, plan, ext: dict, base: int, K: int,

@@ -715,8 +715,11 @@ def burst_cycles(
     carry, outs = jax.lax.scan(cycle, carry0,
                                jnp.arange(K, dtype=jnp.int32))
     head_row, kind, slot, borrows, tgt_words, dirty, dirty_reason = outs
+    # the full final carry is returned so a pipelined caller can chain
+    # the NEXT window's dispatch off the device-resident state (death
+    # rebased by -K, seq_base advanced) without a host re-pack
     return (head_row, kind, slot, borrows, tgt_words, dirty,
-            dirty_reason, carry[-1])
+            dirty_reason, carry)
 
 
 def build_members(forest_of_cq: np.ndarray, n_forests: int,
@@ -1316,9 +1319,12 @@ def pack_burst(structure, queues, cache, scheduler, clock,
     if not ordering.priority_sorting_within_cohort:
         forest_bad[:] = True
     # the kernel's composite candidate-ordering keys pack priority and
-    # reservation-seq into 20-bit fields and uid rank into 19
+    # reservation-seq into 20-bit fields and uid rank into 19; in-burst
+    # admissions consume seq_base..seq_base+K-1, so the headroom is the
+    # largest window the ladder can dispatch (not a hardcoded constant)
     if (np.abs(prio_a).max(initial=0) >= (1 << 20)
-            or seq_base + 128 >= (1 << 20) or n >= (1 << 19)):
+            or seq_base + max(K_BURST_LADDER) >= (1 << 20)
+            or n >= (1 << 19)):
         forest_bad[:] = True
     for ci, name in enumerate(st.cq_names):
         cq_live = cache.cluster_queue(name)
@@ -1377,6 +1383,28 @@ def pack_burst(structure, queues, cache, scheduler, clock,
 K_BURST_LADDER = (32,)
 
 
+@dataclass
+class BurstHandle:
+    """An in-flight fused-burst dispatch.
+
+    The kernel call has been issued (JAX async dispatch: the device —
+    or the XLA-CPU thread pool — executes while the host keeps
+    running); ``BurstSolver.fetch`` blocks for the decisions.  ``carry``
+    keeps the kernel's final scan state as device arrays after fetch,
+    so ``dispatch_next`` can chain the following window's dispatch off
+    it without a host re-pack (double-buffered plan, device-resident)."""
+    plan: BurstPlan
+    K: int
+    runtime: int
+    seq_base: int                # absolute seq base of THIS window
+    dev: object
+    pending: object = None       # kernel output tuple, still async
+    decisions: tuple = None      # fetched numpy decision arrays
+    carry: tuple = None          # final scan state (jax arrays)
+    speculative: bool = False
+    t_dispatch: float = 0.0
+
+
 class BurstSolver:
     """Dispatch fused bursts and expose the decisions for application.
 
@@ -1400,7 +1428,16 @@ class BurstSolver:
                       "burst_dirty_scalar": 0,
                       "burst_dirty_resume": 0,
                       # cycles decided inside bursts by kind
-                      "burst_preempt_cycles": 0}
+                      "burst_preempt_cycles": 0,
+                      # pipelined boundary (speculative next-window
+                      # dispatches chained off the kernel's final carry)
+                      "burst_spec_dispatches": 0,
+                      "burst_overlapped_packs": 0,
+                      "burst_spec_cancelled": 0,
+                      "burst_serial_windows": 0,
+                      "burst_spec_fetch_wait_s": 0.0,
+                      # modeled preempt target vanished before apply
+                      "burst_target_divergences": 0}
 
     def _device(self):
         import jax
@@ -1419,25 +1456,28 @@ class BurstSolver:
                 pass
             return jax.devices("cpu")[0]
 
-    def run(self, plan: BurstPlan, K: int, runtime: int,
-            ext_release: np.ndarray, ext_unpark: np.ndarray):
-        """One fused dispatch of K cycles.  Returns numpy decision arrays
-        (head_row, kind, slot, borrows, tgt_words, dirty, dirty_reason,
-        u_cq)."""
+    def _launch(self, plan: BurstPlan, K: int, runtime: int,
+                ext_release, ext_unpark, state, seq_base: int,
+                speculative: bool) -> BurstHandle:
+        """Issue one fused kernel call without blocking for results.
+        ``state`` is the 9-tuple of *0 scan-state arrays (numpy for a
+        packed window, jax device arrays for a chained one)."""
         import jax
         import time as _time
         st = plan.structure
         dev = self._device()
         a = plan.arrays
+        (elig0, parked0, resume0, adm0, adm_seq0, adm_usage0,
+         adm_uses0, death0, u_cq0) = state
         t0 = _time.perf_counter()
         with jax.default_device(dev):
             out = burst_cycles(
                 a["wl_req"], a["wl_rank"], a["wl_cycle_rank"],
                 a["wl_prio"], a["wl_uidrank"], a["vec_ok"],
-                a["elig0"], a["parked0"], a["resume0"],
-                a["adm0"], a["adm_seq0"], a["adm_usage0"],
-                a["adm_uses0"], a["death0"], np.int32(plan.seq_base),
-                a["u_cq0"],
+                elig0, parked0, resume0,
+                adm0, adm_seq0, adm_usage0,
+                adm_uses0, death0, np.int32(seq_base),
+                u_cq0,
                 a["potential0"], a["subtree"], a["guaranteed"],
                 a["borrow_cap"], a["has_blim"], a["parent"],
                 a["node_level"], a["nominal_cq"], a["npb_cq"],
@@ -1451,17 +1491,93 @@ class BurstSolver:
                 K=K, depth=st.depth, L=plan.L,
                 S=int(st.slot_fr.shape[1]), KC=plan.KC,
                 n_levels=plan.n_levels, G=plan.G, runtime=max(0, runtime))
-            out = jax.device_get(out)
-        dt = _time.perf_counter() - t0
         self.stats["burst_dispatches"] += 1
         self.stats["burst_cycles_decided"] += K
-        self.stats["burst_dispatch_s"] += dt
+        if speculative:
+            self.stats["burst_spec_dispatches"] += 1
+        else:
+            self.stats["burst_serial_windows"] += 1
         if dev.platform != "cpu":
             self.stats["burst_accel_dispatches"] += 1
+        return BurstHandle(plan=plan, K=K, runtime=runtime,
+                           seq_base=seq_base, dev=dev, pending=out,
+                           speculative=speculative, t_dispatch=t0)
+
+    def dispatch(self, plan: BurstPlan, K: int, runtime: int,
+                 ext_release: np.ndarray,
+                 ext_unpark: np.ndarray) -> BurstHandle:
+        """Async dispatch of a freshly packed window."""
+        a = plan.arrays
+        state = (a["elig0"], a["parked0"], a["resume0"], a["adm0"],
+                 a["adm_seq0"], a["adm_usage0"], a["adm_uses0"],
+                 a["death0"], a["u_cq0"])
+        return self._launch(plan, K, runtime, ext_release, ext_unpark,
+                            state, plan.seq_base, speculative=False)
+
+    def dispatch_next(self, handle: BurstHandle, ext_release: np.ndarray,
+                      ext_unpark: np.ndarray) -> BurstHandle | None:
+        """Speculatively chain the NEXT window off a fetched handle's
+        final carry: the plan's static tensors are reused, the scan
+        state stays device-resident, ``death`` is rebased by -K and
+        ``seq_base`` advances by K.  Returns None when the composite-key
+        seq field would overflow (the serial path re-packs and its gate
+        decides).  The caller owns validity: any apply-side divergence
+        from the modeled window must discard the handle unfetched."""
+        import jax.numpy as jnp
+        if handle.carry is None:
+            return None
+        seq_base = handle.seq_base + handle.K
+        # same headroom discipline as pack_burst's overflow gate
+        if seq_base + max(K_BURST_LADDER) >= (1 << 20):
+            return None
+        (elig, parked, resume, adm, adm_seq, adm_usage, adm_uses,
+         death, u_cq) = handle.carry
+        death = jnp.where(adm & (death != INF_I32),
+                          death - np.int32(handle.K), INF_I32)
+        state = (elig, parked, resume, adm, adm_seq, adm_usage,
+                 adm_uses, death, u_cq)
+        return self._launch(handle.plan, handle.K, handle.runtime,
+                            ext_release, ext_unpark, state, seq_base,
+                            speculative=True)
+
+    def fetch(self, handle: BurstHandle):
+        """Block for a dispatched window's decisions.  Returns the numpy
+        tuple (head_row, kind, slot, borrows, tgt_words, dirty,
+        dirty_reason) and parks the final carry on the handle for
+        ``dispatch_next``."""
+        import jax
+        import time as _time
+        if handle.decisions is not None:
+            return handle.decisions
+        t0 = _time.perf_counter()
+        out = handle.pending
+        handle.carry = out[-1]
+        handle.decisions = tuple(jax.device_get(out[:-1]))
+        handle.pending = None
+        dt = _time.perf_counter() - t0
+        if handle.speculative:
+            # residual wait not hidden behind the previous window's
+            # apply loop — the visible pipelined boundary cost
+            self.stats["burst_spec_fetch_wait_s"] += dt
+        else:
+            self.stats["burst_dispatch_s"] += (
+                _time.perf_counter() - handle.t_dispatch)
         import os
         if os.environ.get("KUEUE_BURST_DEBUG"):
             import sys
-            print(f"burst dispatch K={K} M={plan.M} KC={plan.KC} "
-                  f"C={plan.C} dev={dev.platform}: {dt*1e3:.1f} ms",
+            plan = handle.plan
+            print(f"burst fetch K={handle.K} M={plan.M} KC={plan.KC} "
+                  f"C={plan.C} dev={handle.dev.platform} "
+                  f"spec={handle.speculative}: wait {dt*1e3:.1f} ms",
                   file=sys.stderr)
-        return out
+        return handle.decisions
+
+    def run(self, plan: BurstPlan, K: int, runtime: int,
+            ext_release: np.ndarray, ext_unpark: np.ndarray):
+        """One fused dispatch of K cycles, synchronously.  Returns numpy
+        decision arrays (head_row, kind, slot, borrows, tgt_words,
+        dirty, dirty_reason, u_cq)."""
+        import jax
+        handle = self.dispatch(plan, K, runtime, ext_release, ext_unpark)
+        decisions = self.fetch(handle)
+        return decisions + (jax.device_get(handle.carry[-1]),)

@@ -127,6 +127,12 @@ class DispatchHandle:
     route: str = ""   # "accel" | "cpu" | "native" | "no_fit" | "singleton"
 
 
+# Calibration sidecar schema: bump whenever the table's key layout or
+# the measurement protocol changes, so a sidecar written by an older
+# build is rejected (re-measured) instead of mis-routing cycles.
+CALIB_SCHEMA = 2
+
+
 class CycleSolver:
     """Batched solver for the admission cycle.
 
@@ -363,11 +369,23 @@ class CycleSolver:
         fp = hashlib.sha1(fp_src.encode()).hexdigest()[:16]
         calib_name = f"calibration-{fp}.json"
         loaded = compilecache.load_json(calib_name)
-        if loaded is not None:
+        if loaded is not None and (
+                loaded.get("schema") != CALIB_SCHEMA
+                or loaded.get("fingerprint") != fp_src):
+            # a sidecar from another build (or a fingerprint-hash
+            # collision) would route cycles by numbers measured in a
+            # different world: reject it and re-measure
+            self.stats["calibration_rejected"] = 1
+            loaded = None
+        measure = loaded is None
+        if not measure:
             self.calibration.update(
                 {tuple(k): v for k, v in loaded.get("calibration", [])})
             self.stats["calibration_loaded"] = 1
-            return
+            # do NOT return: the shape walk below still runs with
+            # measure=False so every hot kernel shape is eagerly
+            # compiled (one rep, timings discarded) — an evicted XLA
+            # cache entry must cost warmup seconds, never a live cycle
         W = 8
         buckets = []
         while True:
@@ -411,14 +429,15 @@ class CycleSolver:
                 # is distinct from block_until_ready; the LAST rep's time
                 # is the calibration sample
                 name = "accel" if dev is self._accel_dev else "cpu"
-                reps = 3 if dev is self._accel_dev else 2
+                reps = (3 if dev is self._accel_dev else 2) if measure else 1
                 with jax.default_device(dev):
                     if mfw_ladder is None:
                         for _ in range(reps):
                             t0 = _time.perf_counter()
                             jax.device_get(admit_scan(*args, depth=st.depth))
                             dt = _time.perf_counter() - t0
-                        self.calibration[(name, "flat", W, W)] = dt
+                        if measure:
+                            self.calibration[(name, "flat", W, W)] = dt
                         continue
                     for mfw in mfw_ladder:
                         for _ in range(reps):
@@ -427,11 +446,13 @@ class CycleSolver:
                                 *args, st.forest_of_node, depth=st.depth,
                                 n_forests=st.n_forests, max_forest_wl=mfw))
                             dt = _time.perf_counter() - t0
-                        self.calibration[(name, "forest", W, mfw)] = dt
+                        if measure:
+                            self.calibration[(name, "forest", W, mfw)] = dt
             # native core timing: the sequential C++ admit loop competes
             # in the same calibration table, so the router picks the
-            # fastest of native / XLA-CPU / accel per bucket
-            if self.backend == "auto":
+            # fastest of native / XLA-CPU / accel per bucket (nothing to
+            # eager-compile — it is AOT C++ — so skipped when loaded)
+            if measure and self.backend == "auto":
                 try:
                     from .. import native
                     if native.available():
@@ -497,14 +518,16 @@ class CycleSolver:
                         np.zeros((T, F), np.int32), args[-1])
                     for dev in devs:
                         name = "accel" if dev is self._accel_dev else "cpu"
-                        reps = 3 if dev is self._accel_dev else 2
+                        reps = (3 if dev is self._accel_dev
+                                else 2) if measure else 1
                         with jax.default_device(dev):
                             for _ in range(reps):
                                 t0 = _time.perf_counter()
                                 jax.device_get(admit_scan_preempt(
                                     *pargs, depth=st.depth))
                                 dt = _time.perf_counter() - t0
-                        if T == T_LADDER[0] and MT == MT_LADDER[0]:
+                        if (measure and T == T_LADDER[0]
+                                and MT == MT_LADDER[0]):
                             self.calibration[(name, "preempt", W, W)] = dt
 
         # batched preemption search: compile the (S, K) rungs a run of
@@ -539,10 +562,12 @@ class CycleSolver:
                             np.zeros(S, bool), np.zeros(S, bool),
                             depth=st.depth))
 
-        compilecache.save_json(calib_name, {
-            "fingerprint": fp_src,
-            "calibration": [[list(k), v]
-                            for k, v in self.calibration.items()]})
+        if measure:
+            compilecache.save_json(calib_name, {
+                "schema": CALIB_SCHEMA,
+                "fingerprint": fp_src,
+                "calibration": [[list(k), v]
+                                for k, v in self.calibration.items()]})
 
     # -- structure cache -----------------------------------------------
 

@@ -223,6 +223,52 @@ def run_scenario(config: list[dict], driver: Driver | None = None) -> PerfStats:
     return stats
 
 
+def require_accel_or_die() -> None:
+    """Required-mode chip check for the bench entrypoints: with
+    ``--require-accel`` (or ``KUEUE_TPU_REQUIRE_ACCEL=1``) an
+    unreachable accelerator aborts the run instead of silently
+    producing CPU-only numbers.  Also exports the env var so
+    subprocess-based checks (tests/test_accel_route.py) FAIL rather
+    than skip for the rest of the run."""
+    import os
+    os.environ["KUEUE_TPU_REQUIRE_ACCEL"] = "1"
+    import jax
+    accel = [dev for dev in jax.devices() if dev.platform != "cpu"]
+    if not accel:
+        raise SystemExit(
+            "--require-accel: no accelerator platform reachable "
+            f"(devices: {[dev.platform for dev in jax.devices()]})")
+    print(f"require-accel: {len(accel)} {accel[0].platform} device(s)",
+          file=sys.stderr)
+
+
+def burst_boundary_report(bstats: dict) -> dict:
+    """Summarize the burst-boundary pipeline from BurstSolver.stats:
+    how many window boundaries overlapped pack+dispatch with the
+    previous apply (the cost the two-slot pipeline removes from the
+    first cycle of each window), how many speculations were discarded,
+    and how many windows fell back to the serial pack."""
+    spec = bstats.get("burst_spec_dispatches", 0)
+    overlapped = bstats.get("burst_overlapped_packs", 0)
+    cancelled = bstats.get("burst_spec_cancelled", 0)
+    serial = bstats.get("burst_serial_windows", 0)
+    packs = bstats.get("burst_packs", 0)
+    return {
+        "overlapped_packs": overlapped,
+        "spec_dispatches": spec,
+        "spec_cancelled": cancelled,
+        "serial_windows": serial,
+        # pack cost paid serially (per serial window) vs absorbed into
+        # the previous window's apply phase (per overlapped window)
+        "serial_pack_s": round(bstats.get("burst_pack_s", 0.0), 4),
+        "boundary_overlap_share": round(
+            overlapped / max(1, overlapped + packs), 3),
+        "spec_fetch_wait_s": round(
+            bstats.get("burst_spec_fetch_wait_s", 0.0), 4),
+        "target_divergences": bstats.get("burst_target_divergences", 0),
+    }
+
+
 def check_rangespec(stats: PerfStats, rangespec: dict) -> list[str]:
     """reference test/performance/scheduler checker semantics."""
     failures = []

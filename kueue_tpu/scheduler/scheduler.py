@@ -580,7 +580,7 @@ class Scheduler:
     # ------------------------------------------------------------------
 
     def apply_burst_cycle(self, heads: list[Info],
-                          modeled: dict) -> CycleStats:
+                          modeled: dict) -> Optional[CycleStats]:
         """Apply one fused-burst cycle's decisions to the real state.
 
         ``modeled``: {workload key: (kind, slot, borrows, targets)} from
@@ -592,12 +592,26 @@ class Scheduler:
         would — assume + apply for admissions, eviction issuance for
         preemptions, skip/park/reserve requeues — without re-deciding
         anything (reference scheduler.go:211-284 with the decisions
-        precomputed)."""
+        precomputed).
+
+        Returns None — with NO state mutated, not even the cycle
+        counter — when a modeled preempt target has no live admitted
+        Info: the kernel's model of admitted capacity diverged from the
+        real cache, so every decision in the cycle is suspect and the
+        caller must re-decide on the host path."""
         from ..ops.solver import build_slot_assignment
         from ..api.types import (
             IN_CLUSTER_QUEUE_REASON,
             IN_COHORT_RECLAMATION_REASON,
         )
+        # pre-resolve every modeled eviction target BEFORE mutating
+        # anything: a missing target means the modeled admitted set is
+        # stale, which taints the whole cycle, not just one eviction
+        for _kind, _slot, _borrows, _targets in modeled.values():
+            if _kind == "preempt":
+                for tkey, tcq_name in _targets:
+                    if self._live_admitted_info(tcq_name, tkey) is None:
+                        return None
         self.scheduling_cycle += 1
         stats = CycleStats(cycle=self.scheduling_cycle)
         start = self.clock()

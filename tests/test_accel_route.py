@@ -8,6 +8,11 @@ subprocess solves a packed cycle ON the accelerator and checks the
 decisions against the scalar host oracle; infrastructure problems (no
 chip, tunnel down, slow compile) skip rather than fail — only a
 decision divergence on a working chip is a failure.
+
+Required mode: set ``KUEUE_TPU_REQUIRE_ACCEL=1`` (the bench entrypoints
+pass ``--require-accel``) and every infrastructure skip becomes a hard
+FAILURE instead — for environments where "no chip reachable" means the
+run is broken, not optional.
 """
 
 import json
@@ -54,6 +59,18 @@ sys.exit(0 if ok else 1)
 '''
 
 
+def accel_required() -> bool:
+    return os.environ.get("KUEUE_TPU_REQUIRE_ACCEL", "0") not in ("", "0")
+
+
+def _skip_or_fail(msg: str):
+    """Infrastructure problem: normally a skip, but a hard failure in
+    required mode (KUEUE_TPU_REQUIRE_ACCEL=1 / bench --require-accel)."""
+    if accel_required():
+        pytest.fail(f"accelerator required but unavailable: {msg}")
+    pytest.skip(msg)
+
+
 def test_accel_solve_matches_host_oracle():
     env = {k: v for k, v in os.environ.items()
            if k not in ("JAX_PLATFORMS", "XLA_FLAGS")}
@@ -64,16 +81,16 @@ def test_accel_solve_matches_host_oracle():
             cwd=os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
             env=env)
     except subprocess.TimeoutExpired:
-        pytest.skip("accelerator compile/dispatch exceeded 240s "
-                    "(tunnel slow or down)")
+        _skip_or_fail("accelerator compile/dispatch exceeded 240s "
+                      "(tunnel slow or down)")
     lines = [l for l in proc.stdout.strip().splitlines()
              if l.startswith("{")]
     if not lines:
-        pytest.skip(f"accelerator subprocess produced no result "
-                    f"(rc={proc.returncode}): {proc.stderr[-500:]}")
+        _skip_or_fail(f"accelerator subprocess produced no result "
+                      f"(rc={proc.returncode}): {proc.stderr[-500:]}")
     result = json.loads(lines[-1])
     if "skip" in result:
-        pytest.skip(result["skip"])
+        _skip_or_fail(result["skip"])
     assert result["decisions_match"], result
     # the placement must actually have landed on the accelerator —
     # jax.default_device is a hint, so check the output's device set
