@@ -190,6 +190,90 @@ def run(d: Driver, clock: VirtualClock, total: int, waves):
     return wall, cycle, cycle_times, finished, preempted_total, warmup_s
 
 
+def run_burst(d, clock, total, waves):
+    """BENCH_BURST=1: drain through the fused multi-cycle burst path
+    (kueue_tpu.ops.burst) instead of per-cycle schedule_once, so the
+    window-boundary pack counters (delta vs full repacks) land in the
+    bench JSON.  Finishes run inside schedule_burst (runtime= plus
+    external_finishes for carry-over admissions), mirroring
+    scripts/northstar_e2e.py run_burst_path."""
+    warmup_s = 0.0
+    if d.scheduler.solver is not None:
+        t_w = time.perf_counter()
+        d.scheduler.solver.warmup(d.cache.snapshot(),
+                                  len(d.cache.cluster_queue_names()))
+        warmup_s = time.perf_counter() - t_w
+        print(f"solver warmup {warmup_s:.2f}s", file=sys.stderr)
+    cycle_times = []
+    preempted_total = 0
+    all_stats = []
+    pending_waves = sorted(waves.items(),
+                           key=lambda kv: WAVE_AT_CYCLE[kv[0]])
+    last_t = time.perf_counter()
+
+    def on_cycle_start(_k):
+        clock.t += 1.0
+
+    def on_cycle(_k, stats):
+        nonlocal last_t, preempted_total
+        now = time.perf_counter()
+        cycle_times.append(max(0.0, now - last_t - stats.finish_s))
+        last_t = now
+        preempted_total += len(stats.preempted_targets)
+
+    t0 = time.perf_counter()
+    finished = 0
+    while True:
+        # schedule_burst applies finishes itself, so drain completion is
+        # the store's finished count, not an empty stats list (the burst
+        # loop always applies at least one cycle per call)
+        finished = sum(1 for wl in d.workloads.values() if wl.is_finished)
+        if finished >= total and not pending_waves:
+            break
+        cycle = len(cycle_times)
+        for cls, wls in list(pending_waves):
+            if cycle >= WAVE_AT_CYCLE[cls]:
+                for wl in wls:
+                    d.create_workload(wl)
+                pending_waves.remove((cls, wls))
+                gc.collect()
+                gc.freeze()
+        next_wave = min((WAVE_AT_CYCLE[c] for c, _ in pending_waves),
+                        default=None)
+        base = len(all_stats)
+        target = max(base + 1,
+                     next_wave if next_wave is not None else base + 64)
+        ext: dict = {}
+        for j, s in enumerate(all_stats):
+            fin = j + RUNTIME_CYCLES
+            if fin >= base:
+                keys = [k for k in s.admitted
+                        if (wl := d.workloads.get(k)) is not None
+                        and wl.has_quota_reservation]
+                if keys:
+                    ext[fin - base] = keys
+        last_t = time.perf_counter()
+        stats = d.schedule_burst(target - base, runtime=RUNTIME_CYCLES,
+                                 external_finishes=ext,
+                                 on_cycle=on_cycle,
+                                 on_cycle_start=on_cycle_start)
+        all_stats.extend(stats)
+        if not stats and pending_waves:
+            # quiet cycles until the next wave arrives (the per-cycle
+            # path runs them as empty cycles)
+            while len(cycle_times) < next_wave:
+                clock.t += 1.0
+                cycle_times.append(0.0)
+            continue
+        if len(all_stats) > total * 4 + 1000:
+            print(f"bench stalled: cycle={len(all_stats)} "
+                  f"finished={finished}/{total}", file=sys.stderr)
+            break
+    wall = time.perf_counter() - t0
+    return (wall, len(cycle_times), cycle_times, finished,
+            preempted_total, warmup_s)
+
+
 def one_trial(scale: float):
     d, clock, total, waves = build(scale)
     # the 15k-workload object graph is immortal for the trial; keep
@@ -197,7 +281,9 @@ def one_trial(scale: float):
     # north-star scale — scripts/northstar_e2e.py build())
     gc.collect()
     gc.freeze()
-    wall, cycles, cycle_times, finished, preempted, warmup_s = run(
+    run_fn = (run_burst if os.environ.get("BENCH_BURST", "0") == "1"
+              else run)
+    wall, cycles, cycle_times, finished, preempted, warmup_s = run_fn(
         d, clock, total, waves)
     cycle_times.sort()
     p50 = cycle_times[len(cycle_times) // 2] if cycle_times else 0.0
@@ -207,6 +293,8 @@ def one_trial(scale: float):
                finished=finished, total=total, preempted=preempted,
                warmup_s=warmup_s, aps=aps,
                solver_stats=dict(getattr(d.scheduler.solver, "stats", {})),
+               burst_stats=dict(getattr(d._burst_solver, "stats", None)
+                                or {}),
                pre_stats=dict(d.scheduler.preemptor.stats))
     # un-freeze so this trial's (cyclic) driver graph is collectable
     # before the next trial freezes its own
@@ -286,6 +374,14 @@ def main():
             "skipped_noop": solver_stats.get("skipped_dispatches", 0),
         },
         "preemptions": preempted,
+        # window-boundary pack cost (BENCH_BURST=1 drains through the
+        # fused burst path; all-zero under the per-cycle drain)
+        "pack_stats": {
+            k: med["burst_stats"].get(k, 0)
+            for k in ("burst_packs", "burst_delta_packs",
+                      "burst_full_packs", "rows_reused",
+                      "rows_repacked", "delta_pack_s", "burst_pack_s")},
+        "fs_noop_skips": solver_stats.get("fs_noop_skips", 0),
         "scenario_note": ("since r3: staggered arrival + real preemptions "
                           "(harder than r2's all-pending-at-t0; r2's 4898.7 "
                           "adm/s is not comparable)"),

@@ -54,6 +54,12 @@ class Cache:
         # workload key → owning CQ name (O(1) duplicate/ownership lookups;
         # the reference keys cache membership the same way, cache.go:536)
         self._wl_owner: dict[str, str] = {}
+        # dirty-CQ journal feeding the incremental burst pack: admitted
+        # table / usage / assumed-set mutations mark the owning CQ
+        # (utils/journal.py); structure edits need no marks — they bump
+        # structure_generation, which forces a full repack by key
+        from ..utils.journal import PackJournal
+        self.pack_journal = PackJournal()
 
     # ------------------------------------------------------------------
     # ClusterQueues / Cohorts
@@ -189,7 +195,9 @@ class Cache:
                 self._tas_apply(owner.workloads[info.key], -1)
                 owner.remove_workload(owner.workloads[info.key])
                 self._wl_owner.pop(info.key, None)
+                self.pack_journal.touch(owner.name)
             cq = self._mgr.cluster_queues.get(info.obj.admission.cluster_queue)
+            self.pack_journal.touch(info.obj.admission.cluster_queue)
             if cq is None:
                 self.assumed_workloads.discard(info.key)
                 return False
@@ -207,6 +215,14 @@ class Cache:
                 self._tas_apply(cq.workloads[info.key], -1)
                 cq.remove_workload(cq.workloads[info.key])
                 self._wl_owner.pop(info.key, None)
+                self.pack_journal.touch(cq.name)
+            elif info.key in self.assumed_workloads:
+                # the assumed set gates the owner CQ's pending rows
+                owned = getattr(info, "cluster_queue", None)
+                if owned:
+                    self.pack_journal.touch(owned)
+                else:
+                    self.pack_journal.touch_all()
             self.assumed_workloads.discard(info.key)
 
     def assume_workload(self, info: Info) -> bool:
@@ -225,6 +241,7 @@ class Cache:
             self._tas_apply(info, +1)
             self._wl_owner[info.key] = cq.name
             self.assumed_workloads.add(info.key)
+            self.pack_journal.touch(cq.name)
             return True
 
     def forget_workload(self, info: Info) -> bool:
@@ -237,6 +254,13 @@ class Cache:
                 self._tas_apply(cq.workloads[info.key], -1)
                 cq.remove_workload(cq.workloads[info.key])
                 self._wl_owner.pop(info.key, None)
+                self.pack_journal.touch(cq.name)
+            else:
+                owned = getattr(info, "cluster_queue", None)
+                if owned:
+                    self.pack_journal.touch(owned)
+                else:
+                    self.pack_journal.touch_all()
             self.assumed_workloads.discard(info.key)
             return True
 

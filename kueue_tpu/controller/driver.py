@@ -115,6 +115,7 @@ class Driver:
         self.scheduler.metrics = self.metrics
         self._burst_solver = None   # lazy BurstSolver (ops/burst.py)
         self._burst_m = 0           # sticky M bucket across burst packs
+        self._burst_pack_state = None  # persistent delta-pack records
 
     @classmethod
     def from_config(cls, cfg, clock: Callable[[], float] = time.time,
@@ -171,6 +172,8 @@ class Driver:
         self.limit_ranges.setdefault(lr.namespace, {})[lr.name] = lr
         self.scheduler.limit_range_summaries[lr.namespace] = summarize(
             list(self.limit_ranges[lr.namespace].values()))
+        # LimitRange summaries gate pack rows globally (no per-CQ map)
+        self.queues.pack_journal.touch_all()
         # a relaxed range can unblock parked workloads
         self._wake_all()
 
@@ -378,6 +381,15 @@ class Driver:
         st.state = state
         st.message = message
         st.last_transition_time = now
+        # check states gate pack rows but mutate in place (no queue or
+        # cache write on the pending path) — mark the routed CQ dirty
+        lq = self.queues.local_queues.get(f"{wl.namespace}/{wl.queue_name}")
+        if lq is not None:
+            self.queues.pack_journal.touch(lq.cluster_queue)
+        elif wl.admission is not None:
+            self.queues.pack_journal.touch(wl.admission.cluster_queue)
+        else:
+            self.queues.pack_journal.touch_all()
         if state == AdmissionCheckState.READY:
             if sync_admitted_condition(wl, now):
                 cq_name = wl.admission.cluster_queue if wl.admission else ""
@@ -664,7 +676,8 @@ class Driver:
         Returns the list of per-cycle CycleStats actually applied."""
         import os
         import numpy as np
-        from ..ops.burst import BurstSolver, pack_burst, K_BURST_LADDER
+        from ..ops.burst import (BurstSolver, pack_burst_cached,
+                                 K_BURST_LADDER)
 
         ext = {int(k): list(v) for k, v in
                (external_finishes or {}).items()}
@@ -800,9 +813,10 @@ class Driver:
                 K = next((r for r in K_BURST_LADDER if r >= min(
                     remaining, K_BURST_LADDER[-1])), K_BURST_LADDER[-1])
                 _t_pack = time.perf_counter()
-                plan = pack_burst(st, self.queues, self.cache,
-                                  self.scheduler, self.clock,
-                                  min_m=self._burst_m, window=K)
+                plan, self._burst_pack_state, _ = pack_burst_cached(
+                    st, self.queues, self.cache, self.scheduler,
+                    self.clock, state=self._burst_pack_state,
+                    min_m=self._burst_m, window=K, stats=bstats)
                 bstats["burst_pack_s"] += time.perf_counter() - _t_pack
                 bstats["burst_packs"] += 1
                 if plan is None:

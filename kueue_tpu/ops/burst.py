@@ -941,6 +941,580 @@ def admitted_usage_vec(info, st, scale_of: dict, F: int) -> Optional[tuple]:
     return out
 
 
+_PACK_FAIL = object()   # sentinel: this CQ fails the whole pack
+
+
+class _CQRows:
+    """One CQ's packed rows (pending then admitted) plus the per-CQ
+    facts stage B needs.  Records are the unit of delta reuse: a clean
+    record re-enters ``_assemble_plan`` untouched while a dirty CQ
+    re-walks into a fresh record.  Row order within a record never
+    reaches the plan — every stage-B rank comes from a total-order
+    lexsort with a unique final tiebreak — so reuse stays bit-identical
+    even though a re-walk may enumerate members differently."""
+    __slots__ = ("ci", "pos", "strict", "bad", "truncated",
+                 "n_pend", "n_adm", "keys", "uids", "prio", "ts",
+                 "res_ts", "parked", "ok", "resume", "adm", "req",
+                 "usage", "uses", "u_row", "index_of_key", "infos")
+
+    @property
+    def n_rows(self) -> int:
+        return self.n_pend + self.n_adm
+
+
+class _PackStatics:
+    """Structure-keyed stage-B tables: tree levels, forest membership,
+    preemption-policy flags and the zero-usage potential — all pure
+    functions of the packed structure (CQ/cohort spec edits bump the
+    structure generation), memoized on the structure object so re-packs
+    and delta packs skip the O(N·depth) Python walks."""
+    __slots__ = ("forest_of_cq", "node_level", "n_levels", "L",
+                 "members", "deep", "wcq_lower", "rwc_enabled",
+                 "rwc_only_lower", "modelable_base", "potential0",
+                 "cand_tables")
+
+
+def _pack_statics(st, cache) -> _PackStatics:
+    s = getattr(st, "_burst_statics", None)
+    if s is not None:
+        return s
+    from ..api.types import (BorrowWithinCohortPolicy,
+                             ReclaimWithinCohort, WithinClusterQueue)
+    from .cycle import available_all_np
+    C = len(st.cq_names)
+    F = max(1, len(st.fr_index))
+    G = st.n_forests
+    N = st.node_count
+    parent = st.parent
+    s = _PackStatics()
+    s.cand_tables = {}
+    s.forest_of_cq = st.forest_of_node[:C].astype(np.int32)
+    node_level = np.zeros(N, dtype=np.int32)
+    for ni in range(N):
+        lvl, p = 0, parent[ni]
+        while p >= 0:
+            lvl += 1
+            p = parent[p]
+        node_level[ni] = lvl
+    # node_level[ni] = distance from root (roots = 0); rebuild_usage
+    # sweeps deepest levels first via range(n_levels-1, 0, -1)
+    s.node_level = node_level
+    s.n_levels = int(node_level.max()) + 1
+    per_forest = np.bincount(s.forest_of_cq, minlength=G)
+    s.L = max(1, int(per_forest.max()))
+    s.members = build_members(s.forest_of_cq, G, s.L)
+    # forest depth > 2 (nested cohorts) is outside the envelope
+    deep = np.zeros(G, dtype=bool)
+    np.maximum.at(deep, s.forest_of_cq, node_level[:C] > 1)
+    s.deep = deep
+    wcq_lower = np.zeros(C, dtype=bool)
+    rwc_enabled = np.zeros(C, dtype=bool)
+    rwc_only_lower = np.zeros(C, dtype=bool)
+    modelable_base = np.zeros(C, dtype=bool)
+    for ci, name in enumerate(st.cq_names):
+        cq_live = cache.cluster_queue(name)
+        if cq_live is None:
+            continue
+        pol = cq_live.spec.preemption
+        wcq_lower[ci] = (pol.within_cluster_queue
+                         == WithinClusterQueue.LOWER_PRIORITY)
+        rwc_enabled[ci] = (pol.reclaim_within_cohort
+                           != ReclaimWithinCohort.NEVER)
+        rwc_only_lower[ci] = (pol.reclaim_within_cohort
+                              == ReclaimWithinCohort.LOWER_PRIORITY)
+        modelable_base[ci] = (
+            pol.borrow_within_cohort.policy
+            == BorrowWithinCohortPolicy.NEVER
+            and pol.within_cluster_queue
+            != WithinClusterQueue.LOWER_OR_NEWER_EQUAL_PRIORITY)
+    s.wcq_lower = wcq_lower
+    s.rwc_enabled = rwc_enabled
+    s.rwc_only_lower = rwc_only_lower
+    s.modelable_base = modelable_base
+    s.potential0 = np.minimum(available_all_np(
+        np.zeros((N, F), np.int64), st.subtree_quota, st.guaranteed,
+        st.borrow_cap, st.has_borrow_limit, st.parent, st.depth),
+        np.int64(I32_MAX)).astype(np.int32)
+    st._burst_statics = s
+    return s
+
+
+def _unknown_active_cq(st, queues) -> bool:
+    """An active CQ with pending work the structure doesn't know about
+    fails the pack (the kernel can't model it at all)."""
+    known = st.cq_index
+    for name in queues.cluster_queue_names():
+        if name in known:
+            continue
+        q = queues.queue_for(name)
+        if q is not None and q.active and q.pending_active():
+            return True
+    return False
+
+
+def _pack_cq_rows(st, ci, pos, queues, cache, scheduler, assumed,
+                  scale_of, window):
+    """Stage A for ONE ClusterQueue: walk its heap + parking lot and
+    its admitted table into a _CQRows record, or _PACK_FAIL when the CQ
+    can't be represented (missing from the cache, inexact usage
+    scaling)."""
+    from ..api.types import (QueueingStrategy, AdmissionCheckState,
+                             WL_EVICTED, WL_QUOTA_RESERVED)
+    from .packing import scaled_usage_row
+    ordering = scheduler.ordering
+    qts = ordering.queue_order_timestamp
+    F = max(1, len(st.fr_index))
+    R = len(st.resource_names)
+    gen = st.generation
+    cq_name = st.cq_names[ci]
+    cq_live = cache.cluster_queue(cq_name)
+    if cq_live is None:
+        return _PACK_FAIL
+    u_row = scaled_usage_row(st, cq_live)
+    if u_row is None:
+        return _PACK_FAIL
+
+    rec = _CQRows()
+    rec.ci = ci
+    rec.pos = pos
+    rec.bad = False
+    rec.truncated = False
+
+    q = queues.queue_for(cq_name)
+    active = q is not None and q.active
+    rec.strict = bool(
+        active and q.queueing_strategy == QueueingStrategy.STRICT_FIFO)
+    members = []
+    parked_keys = set()
+    if active:
+        members.extend(q.heap.items())
+        for key, info in q.inadmissible.items():
+            rs = info.obj.requeue_state
+            if rs is not None and rs.requeue_at is not None:
+                # backoff-parked: excluded; a mid-burst expiry diverges
+                # the heads and the application validator truncates
+                continue
+            members.append(info)
+            parked_keys.add(info.key)
+
+    if window > 0:
+        cap = window + 2
+        if len(members) > cap:
+            import heapq
+
+            def sel_key(info):
+                # the tuple is rebuilt ~rows×windows times at scale;
+                # cache it per structure generation alongside the row
+                sel = getattr(info, "_burst_sel", None)
+                if sel is not None and sel[0] == gen:
+                    return sel[1]
+                row = getattr(info, "_burst_row", None)
+                if row is not None and row[0] == gen:
+                    t = (-row[5], row[4], info.key)
+                else:
+                    obj = info.obj
+                    t = (-obj.priority, qts(obj), info.key)
+                info._burst_sel = (gen, t)
+                return t
+
+            members = heapq.nsmallest(cap, members, key=sel_key)
+            rec.truncated = True
+
+    admitted = []
+    for key, info in cq_live.workloads.items():
+        obj = info.obj
+        # assumed-but-applied workloads are normal candidates (the
+        # apply hook is synchronous here; a failed apply forgets the
+        # assumption before the cycle returns) — only a live evicted
+        # condition or a missing reservation breaks the modeled
+        # candidate ordering
+        if (obj.condition_true(WL_EVICTED)
+                or obj.conditions.get(WL_QUOTA_RESERVED) is None):
+            rec.bad = True
+            continue
+        admitted.append(info)
+
+    covers_pods = cq_name in st.cq_covers_pods
+    cq_ok = st.cq_vector_ok
+    cq_vec = bool(cq_ok[ci]) if cq_ok is not None else False
+    if cq_vec and cq_live.spec.namespace_selector:
+        cq_vec = False   # selector evaluation stays on the host path
+    lr_summaries = scheduler.limit_range_summaries
+    allocatable = cq_live.allocatable_generation
+
+    n_upper = len(members) + len(admitted)
+    prio_l: list[int] = []
+    ts_l: list[float] = []
+    res_ts_l: list[float] = []
+    parked_l: list[bool] = []
+    ok_l: list[bool] = []
+    resume_l: list[bool] = []
+    key_l: list[str] = []
+    uid_l: list[str] = []
+    infos: list = []
+    req_mat = np.zeros((n_upper, R), dtype=np.int32)
+    usage_mat = np.zeros((n_upper, F), dtype=np.int32)
+    uses_mat = np.zeros((n_upper, F), dtype=bool)
+
+    i = 0
+    for info in members:
+        row = getattr(info, "_burst_row", None)
+        if row is None or row[0] != gen or row[1] != covers_pods:
+            row = (gen, *_static_row(info, st, covers_pods, qts))
+            info._burst_row = row
+        _, _, req_vec, static_ok, ts, prio, uid = row
+        key = info.key
+        key_l.append(key)
+        uid_l.append(uid)
+        prio_l.append(prio)
+        ts_l.append(ts)
+        res_ts_l.append(0.0)
+        parked_l.append(key in parked_keys)
+        req_mat[i] = req_vec
+        ok = cq_vec and static_ok
+        if ok:
+            obj = info.obj
+            if lr_summaries and lr_summaries.get(obj.namespace):
+                ok = False   # LimitRange bounds stay host-side
+            elif key in assumed or obj.admission is not None:
+                ok = False
+            elif obj.admission_check_states and any(
+                    stt.state in (AdmissionCheckState.RETRY,
+                                  AdmissionCheckState.REJECTED)
+                    for stt in obj.admission_check_states.values()):
+                ok = False
+        ok_l.append(ok)
+        last = info.last_assignment
+        resume_l.append(
+            last is not None
+            and getattr(last, "pending_flavors", False)
+            and last.cluster_queue_generation >= allocatable)
+        infos.append(info)
+        i += 1
+    rec.n_pend = i
+
+    for info in admitted:
+        row = getattr(info, "_burst_row", None)
+        if row is None or row[0] != gen or row[1] != covers_pods:
+            row = (gen, *_static_row(info, st, covers_pods, qts))
+            info._burst_row = row
+        _, _, req_vec, static_ok, ts, prio, uid = row
+        uv = admitted_usage_vec(info, st, scale_of, F)
+        if uv is None:
+            # not representable as a target/release row: the host
+            # handles its cycles (forest out of the envelope) and
+            # its finish via the ext_release path
+            rec.bad = True
+            continue
+        key_l.append(info.key)
+        uid_l.append(uid)
+        prio_l.append(prio)
+        ts_l.append(ts)
+        parked_l.append(False)
+        obj = info.obj
+        cond = obj.conditions.get(WL_QUOTA_RESERVED)
+        res_ts_l.append(cond.last_transition_time)
+        req_mat[i] = req_vec
+        usage_mat[i], uses_mat[i] = uv
+        # post-eviction afterlife: the same dynamic gates pending
+        # rows get (LimitRange bounds, failed admission checks) —
+        # an in-burst-evicted row the kernel re-admits must honor
+        # everything the host nominate would; gating extra is safe
+        # (the cycle goes dirty), gating less diverges decisions
+        ok = cq_vec and static_ok
+        if ok:
+            if lr_summaries and lr_summaries.get(obj.namespace):
+                ok = False
+            elif obj.admission_check_states and any(
+                    stt.state in (AdmissionCheckState.RETRY,
+                                  AdmissionCheckState.REJECTED)
+                    for stt in obj.admission_check_states.values()):
+                ok = False
+        ok_l.append(ok)
+        resume_l.append(False)
+        infos.append(info)
+        i += 1
+    rec.n_adm = i - rec.n_pend
+
+    rec.keys = (np.asarray(key_l) if key_l
+                else np.empty(0, dtype="U1"))
+    rec.uids = (np.asarray(uid_l) if uid_l
+                else np.empty(0, dtype="U1"))
+    rec.prio = np.array(prio_l, dtype=np.int64)
+    rec.ts = np.array(ts_l, dtype=np.float64)
+    rec.res_ts = np.array(res_ts_l, dtype=np.float64)
+    rec.parked = np.array(parked_l, dtype=bool)
+    rec.ok = np.array(ok_l, dtype=bool)
+    rec.resume = np.array(resume_l, dtype=bool)
+    adm = np.zeros(i, dtype=bool)
+    adm[rec.n_pend:] = True
+    rec.adm = adm
+    rec.req = req_mat[:i]
+    rec.usage = usage_mat[:i]
+    rec.uses = uses_mat[:i]
+    rec.u_row = u_row
+    rec.index_of_key = {k: j for j, k in enumerate(key_l)}
+    rec.infos = infos
+    return rec
+
+
+def _walk_records(st, queues, cache, scheduler, window):
+    """Stage A over every CQ; None when any CQ fails the pack."""
+    C = len(st.cq_names)
+    # CQ-position order (the queue manager's heads enumeration order)
+    pos_of = {name: i for i, name in
+              enumerate(queues.cluster_queue_names())}
+    assumed = cache.assumed_workloads
+    scale_of = {r: int(st.resource_scale[i])
+                for i, r in enumerate(st.resource_names)}
+    records = []
+    for ci in range(C):
+        rec = _pack_cq_rows(st, ci, pos_of.get(st.cq_names[ci], C),
+                            queues, cache, scheduler, assumed,
+                            scale_of, window)
+        if rec is _PACK_FAIL:
+            return None
+        records.append(rec)
+    return records
+
+
+_ROW_ATTRS = ("adm", "prio", "ts", "res_ts", "parked", "ok",
+              "resume", "req", "usage", "uses", "keys", "uids")
+
+
+def _concat_row_fields(records, nz, prev):
+    """Concatenate the per-record row arrays into flat stage-B fields.
+
+    ``prev`` (previous record list + its concatenated fields, from the
+    delta state) turns the 1000-segment concatenation into a few-chunk
+    splice: runs of reused record objects slice the cached flat arrays
+    (their rows are unchanged by construction), only re-walked records
+    contribute fresh segments.  Returns (fields, bounds) with the same
+    values a plain concatenation would produce."""
+    chunks = None
+    if prev is not None:
+        prev_records, prev_fields = prev
+        if prev_fields is not None and len(prev_records) == len(records):
+            bounds = prev_fields["_bounds"]
+            chunks = []          # (0, lo, hi) = prev slice; (1, i, 0)
+            run = None
+            for i, r in enumerate(records):
+                if r is prev_records[i]:
+                    if run is None:
+                        run = [int(bounds[i]), int(bounds[i + 1])]
+                    else:
+                        run[1] = int(bounds[i + 1])
+                else:
+                    if run is not None:
+                        chunks.append((0, run[0], run[1]))
+                        run = None
+                    if r.n_rows:
+                        chunks.append((1, i, 0))
+            if run is not None:
+                chunks.append((0, run[0], run[1]))
+    fields = {}
+    if chunks is not None:
+        for attr in _ROW_ATTRS:
+            prev_arr = prev_fields[attr]
+            fields[attr] = np.concatenate(
+                [prev_arr[lo:hi] if tag == 0
+                 else getattr(records[lo], attr)
+                 for tag, lo, hi in chunks]) if chunks else prev_arr[:0]
+    else:
+        for attr in _ROW_ATTRS:
+            fields[attr] = np.concatenate(
+                [getattr(r, attr) for r in nz])
+    n_rows_arr = np.fromiter((r.n_rows for r in records),
+                             dtype=np.int64, count=len(records))
+    fields["_bounds"] = np.concatenate(
+        ([0], np.cumsum(n_rows_arr)))
+    return fields
+
+
+def _assemble_plan(st, records, cache, scheduler, min_m,
+                   prev=None, fields_out=None):
+    """Stage B: fuse per-CQ row records into the dense [C, M] plan.
+
+    Pure vectorized numpy over the concatenated rows; every rank comes
+    from a total-order lexsort (key/uid final tiebreaks), so the output
+    is independent of record row order and a plan assembled from
+    delta-refreshed records is bit-identical to a full re-walk of the
+    same live state.  ``prev``/``fields_out`` carry the flat row arrays
+    across windows for the delta path (see ``_concat_row_fields``)."""
+    ordering = scheduler.ordering
+    C = len(st.cq_names)
+    F = max(1, len(st.fr_index))
+    R = len(st.resource_names)
+    n_pending = sum(r.n_pend for r in records)
+    if n_pending == 0:
+        return None
+    s = _pack_statics(st, cache)
+    G = st.n_forests
+    forest_of_cq = s.forest_of_cq
+    L = s.L
+    node_level = s.node_level
+
+    from .packing import _bucket
+    # sticky minimum keeps M stable across re-packs as queues drain
+    # (every distinct M is a fresh XLA compilation)
+    rows_per_cq = max(r.n_rows for r in records)
+    M = max(_bucket(rows_per_cq, minimum=4), min_m)
+
+    nz = [r for r in records if r.n_rows > 0]
+    fields = _concat_row_fields(records, nz, prev)
+    if fields_out is not None:
+        fields_out.update(fields)
+    n_rows_arr = np.diff(fields["_bounds"])
+    ci_a = np.repeat(
+        np.fromiter((r.ci for r in records), dtype=np.int32, count=C),
+        n_rows_arr)
+    pos_a = np.repeat(
+        np.fromiter((r.pos for r in records), dtype=np.int32, count=C),
+        n_rows_arr)
+    adm_a = fields["adm"]
+    prio_a = fields["prio"]
+    ts_a = fields["ts"]
+    parked_a = fields["parked"]
+    res_ts_a = fields["res_ts"]
+    ok_a = fields["ok"]
+    resume_a = fields["resume"]
+    req_all = fields["req"]
+    usage_all = fields["usage"]
+    uses_all = fields["uses"]
+    key_arr = fields["keys"]
+    uid_arr = fields["uids"]
+    n = int(fields["_bounds"][-1])
+    strict = np.fromiter((r.strict for r in records), dtype=bool,
+                         count=C)
+
+    wl_req = np.zeros((C, M, R), dtype=np.int32)
+    wl_rank = np.full((C, M), INF_I32, dtype=np.int32)
+    wl_cycle_rank = np.zeros((C, M), dtype=np.int32)
+    wl_prio = np.zeros((C, M), dtype=np.int32)
+    wl_uidrank = np.zeros((C, M), dtype=np.int32)
+    vec_ok = np.zeros((C, M), dtype=bool)
+    elig = np.zeros((C, M), dtype=bool)
+    parked = np.zeros((C, M), dtype=bool)
+    resume = np.zeros((C, M), dtype=bool)
+    adm = np.zeros((C, M), dtype=bool)
+    adm_seq = np.zeros((C, M), dtype=np.int32)
+    adm_usage = np.zeros((C, M, F), dtype=np.int32)
+    adm_uses = np.zeros((C, M, F), dtype=bool)
+    death = np.full((C, M), I32_MAX, dtype=np.int32)
+
+    # heap rank within each CQ: one global lexsort replaces C Python
+    # sorts (priority desc, queue-order ts asc, key asc —
+    # cluster_queue.go:408).  Admitted rows get ranks too: a preempted
+    # target re-enters the heap at exactly this position (preemption
+    # evictions keep the creation-time ordering, workload.py:309).
+    order = np.lexsort((key_arr, ts_a, -prio_a, ci_a))
+    ci_sorted = ci_a[order]
+    first = np.ones(n, dtype=bool)
+    first[1:] = ci_sorted[1:] != ci_sorted[:-1]
+    seg_start = np.maximum.accumulate(
+        np.where(first, np.arange(n), 0))
+    mi_sorted = (np.arange(n) - seg_start).astype(np.int64)
+    mi_a = np.empty(n, dtype=np.int64)
+    mi_a[order] = mi_sorted
+    # global cycle-order rank (priority desc, ts asc, heads-position);
+    # the key tiebreak keeps the rank independent of heap-array order
+    # (pops/pushes permute heap.items()), which delta reuse requires
+    crank = np.empty(n, dtype=np.int64)
+    crank[np.lexsort((key_arr, pos_a, ts_a, -prio_a))] = np.arange(n)
+    # uid rank (candidatesOrdering final tiebreak) + reservation-time
+    # dense rank (ties share a value; uid breaks them separately)
+    uidrank = np.empty(n, dtype=np.int64)
+    uidrank[np.argsort(uid_arr, kind="stable")] = np.arange(n)
+    uniq_ts = np.unique(res_ts_a[adm_a]) if adm_a.any() else np.empty(0)
+    seq_a = np.zeros(n, dtype=np.int64)
+    if len(uniq_ts):
+        seq_a[adm_a] = np.searchsorted(uniq_ts, res_ts_a[adm_a]) + 1
+    seq_base = int(len(uniq_ts)) + 2
+
+    wl_rank[ci_a, mi_a] = mi_a
+    wl_cycle_rank[ci_a, mi_a] = crank
+    wl_prio[ci_a, mi_a] = np.clip(prio_a, -I32_MAX, I32_MAX)
+    wl_uidrank[ci_a, mi_a] = uidrank
+    parked[ci_a, mi_a] = parked_a
+    elig[ci_a, mi_a] = ~parked_a & ~adm_a
+    vec_ok[ci_a, mi_a] = ok_a
+    resume[ci_a, mi_a] = resume_a
+    wl_req[ci_a, mi_a] = req_all
+    adm[ci_a, mi_a] = adm_a
+    adm_seq[ci_a, mi_a] = seq_a
+    adm_usage[ci_a, mi_a] = usage_all
+    adm_uses[ci_a, mi_a] = uses_all
+    key_list = key_arr.tolist()   # plain str (key_arr is unicode-dtype)
+    keys_grid = np.empty((C, M), dtype=object)   # fills with None
+    keys_grid[ci_a, mi_a] = np.array(key_list, dtype=object)
+    keys: list[list] = keys_grid.tolist()
+    row_of_key: dict = dict(zip(
+        key_list, zip(ci_a.tolist(), mi_a.tolist())))
+
+    # CQ-level usage, scaled exactly (else no burst) — per-record rows
+    if (prev is not None and prev[1] is not None
+            and "u_cq" in prev[1] and len(prev[0]) == len(records)):
+        u_cq = prev[1]["u_cq"].copy()
+        for i, r in enumerate(records):
+            if r is not prev[0][i]:
+                u_cq[i] = r.u_row
+    else:
+        u_cq = np.stack([r.u_row for r in records])
+    if fields_out is not None:
+        fields_out["u_cq"] = u_cq
+
+    # preemption policy flags + the in-kernel modeling envelope
+    forest_bad = s.deep.copy()
+    for r in records:
+        if r.bad:
+            forest_bad[int(forest_of_cq[r.ci])] = True
+    KC = min(KC_CAP, ((L * M + 31) // 32) * 32)
+    if L * M > KC:
+        forest_bad[:] = True
+    if not ordering.priority_sorting_within_cohort:
+        forest_bad[:] = True
+    # the kernel's composite candidate-ordering keys pack priority and
+    # reservation-seq into 20-bit fields and uid rank into 19; in-burst
+    # admissions consume seq_base..seq_base+K-1, so the headroom is the
+    # largest window the ladder can dispatch (not a hardcoded constant)
+    if (np.abs(prio_a).max(initial=0) >= (1 << 20)
+            or seq_base + max(K_BURST_LADDER) >= (1 << 20)
+            or n >= (1 << 19)):
+        forest_bad[:] = True
+    preempt_ok = s.modelable_base & ~forest_bad[forest_of_cq]
+    # pure function of the structure statics + (M, KC); M is sticky
+    # across re-packs, so boundaries after the first reuse the tables
+    tables = s.cand_tables.get((M, KC))
+    if tables is None:
+        tables = build_candidate_tables(forest_of_cq, s.members, M, KC)
+        s.cand_tables[(M, KC)] = tables
+    cand_rows, cand_lmem, self_lmem = tables
+
+    arrays = dict(
+        wl_req=wl_req, wl_rank=wl_rank, wl_cycle_rank=wl_cycle_rank,
+        wl_prio=wl_prio, wl_uidrank=wl_uidrank,
+        vec_ok=vec_ok, elig0=elig, parked0=parked, resume0=resume,
+        adm0=adm, adm_seq0=adm_seq, adm_usage0=adm_usage,
+        adm_uses0=adm_uses, death0=death,
+        u_cq0=u_cq, potential0=s.potential0,
+        subtree=st.subtree_quota, guaranteed=st.guaranteed,
+        borrow_cap=st.borrow_cap, has_blim=st.has_borrow_limit,
+        parent=st.parent, node_level=node_level,
+        nominal_cq=st.nominal_cq, npb_cq=st.nominal_plus_blimit_cq,
+        slot_fr=st.slot_fr, slot_valid=st.slot_valid,
+        cq_can_preempt_borrow=st.cq_can_preempt_borrow,
+        forest_of_cq=forest_of_cq, strict_cq=strict,
+        wcq_lower=s.wcq_lower, rwc_enabled=s.rwc_enabled,
+        rwc_only_lower=s.rwc_only_lower, preempt_ok=preempt_ok,
+        members=s.members, cand_rows=cand_rows, cand_lmem=cand_lmem,
+        self_lmem=self_lmem)
+    return BurstPlan(structure=st, arrays=arrays, keys=keys,
+                     C=C, M=M, L=L, G=G, n_levels=s.n_levels, KC=KC,
+                     seq_base=seq_base, row_of_key=row_of_key,
+                     max_res_ts=(float(res_ts_a[adm_a].max())
+                                 if adm_a.any() else None))
+
+
 def pack_burst(structure, queues, cache, scheduler, clock,
                min_m: int = 0, window: int = 0) -> Optional[BurstPlan]:
     """Build the dense [C, M] state from the live queues + cache.
@@ -961,420 +1535,199 @@ def pack_burst(structure, queues, cache, scheduler, clock,
     head within the window; any modeling miss is caught by the driver's
     per-cycle heads validation (truncate + repack)."""
     st = structure
-    C = len(st.cq_names)
-    F = max(1, len(st.fr_index))
-    R = len(st.resource_names)
-    S = st.slot_fr.shape[1]
-    ordering = scheduler.ordering
+    if _unknown_active_cq(st, queues):
+        return None   # an active CQ the structure doesn't know
+    records = _walk_records(st, queues, cache, scheduler, window)
+    if records is None:
+        return None
+    return _assemble_plan(st, records, cache, scheduler, min_m)
 
-    # CQ-position order (the queue manager's heads enumeration order)
-    cq_pos = {name: i for i, name in
-              enumerate(queues.cluster_queue_names())}
 
-    members_by_ci: list[list] = [[] for _ in range(C)]
-    parked_by_ci: list[set] = [set() for _ in range(C)]
-    admitted_by_ci: list[list] = [[] for _ in range(C)]
-    strict = np.zeros(C, dtype=bool)
-    from ..api.types import (
-        QueueingStrategy, BorrowWithinCohortPolicy, ReclaimWithinCohort,
-        WithinClusterQueue, WL_EVICTED, WL_QUOTA_RESERVED)
-    for name in queues.cluster_queue_names():
-        ci = st.cq_index.get(name)
-        q = queues.queue_for(name)
-        if ci is None:
-            if q is not None and q.active and q.pending_active():
-                return None   # an active CQ the structure doesn't know
-            continue
-        if q is None or not q.active:
-            continue
-        strict[ci] = q.queueing_strategy == QueueingStrategy.STRICT_FIFO
-        for info in q.heap.items():
-            members_by_ci[ci].append(info)
-        for key, info in q.inadmissible.items():
+class DeltaPackState:
+    """Persistent per-CQ row records carried across burst windows.
+
+    Valid for one (structure generation, resource scale, CQ set,
+    window) key; ``pack_burst_cached`` re-walks only journaled-dirty
+    CQs against it and re-fuses stage B from the mixed records.
+    ``fields`` holds the flat stage-B row concatenation so the next
+    window splices only the dirty segments."""
+    __slots__ = ("key", "records", "fields")
+
+    def __init__(self, key, records, fields=None):
+        self.key = key
+        self.records = records
+        self.fields = fields
+
+
+def _roundtrips_clean(rec, q, cq_live, keys) -> bool:
+    """Verify that popped-and-requeued heads still match their packed
+    rows: same Info object, same parked bit, same flavor-resume bit.
+    These are the only row facts a pop/requeue roundtrip can move
+    without hitting a hard journal touch."""
+    if q is None or not q.active or cq_live is None:
+        return False
+    allocatable = cq_live.allocatable_generation
+    for key in keys:
+        parked_now = False
+        info = q.heap.get(key)
+        if info is None:
+            info = q.inadmissible.get(key)
+            if info is None:
+                return False
             rs = info.obj.requeue_state
             if rs is not None and rs.requeue_at is not None:
-                # backoff-parked: excluded; a mid-burst expiry diverges
-                # the heads and the application validator truncates
-                continue
-            members_by_ci[ci].append(info)
-            parked_by_ci[ci].add(info.key)
+                return False   # now backoff-parked: membership changed
+            parked_now = True
+        idx = rec.index_of_key.get(key)
+        if idx is None:
+            # below the window cutoff is the only legitimate absence
+            if not rec.truncated:
+                return False
+            continue
+        if rec.infos[idx] is not info or idx >= rec.n_pend:
+            return False
+        if bool(rec.parked[idx]) != parked_now:
+            return False
+        last = info.last_assignment
+        resume_now = (
+            last is not None
+            and getattr(last, "pending_flavors", False)
+            and last.cluster_queue_generation >= allocatable)
+        if bool(rec.resume[idx]) != resume_now:
+            return False
+    return True
 
-    if window > 0:
-        import heapq
-        cap = window + 2
-        qts_sel = ordering.queue_order_timestamp
 
-        def sel_key(info):
-            row = getattr(info, "_burst_row", None)
-            if row is not None and row[0] == st.generation:
-                return (-row[5], row[4], info.key)
-            obj = info.obj
-            return (-obj.priority, qts_sel(obj), info.key)
+# above this dirty share a delta walk rebuilds nearly everything anyway
+# and the journal bookkeeping makes it slower than a plain full pack
+_DELTA_MAX_DIRTY_FRAC = 0.5
+_DELTA_MIN_DIRTY_CQS = 8
 
-        for ci in range(C):
-            if len(members_by_ci[ci]) > cap:
-                members_by_ci[ci] = heapq.nsmallest(
-                    cap, members_by_ci[ci], key=sel_key)
 
-    n_pending = sum(len(m) for m in members_by_ci)
-    if n_pending == 0:
-        return None
+def pack_burst_cached(structure, queues, cache, scheduler, clock,
+                      state=None, min_m: int = 0, window: int = 0,
+                      stats=None):
+    """Delta-maintained pack_burst; returns ``(plan, state, was_delta)``.
 
-    # admitted rows: the quota-holding table (preemption candidates +
-    # modeled finish releases); forest_bad gates a forest out of the
-    # in-kernel preemption envelope without failing the pack
-    G = st.n_forests
-    forest_of_cq = st.forest_of_node[:C].astype(np.int32)
-    forest_bad = np.zeros(G, dtype=bool)
+    Drains the queue-manager and cache PackJournals; when ``state``
+    covers the same (structure generation, resource scale, CQ set,
+    window) key and nothing forced a full walk, only journaled-dirty
+    CQs are re-walked and the surviving records re-fuse through stage B
+    — the boundary pays O(dirty rows) of Python walk instead of O(all
+    rows).  Any miss (key change, dirty-all, roundtrip drift, CQ the
+    delta path can't model) falls back to a full walk, counted in
+    ``stats``.  The returned plan is bit-identical to ``pack_burst`` of
+    the same live state (test-enforced by tests/test_delta_pack.py);
+    ``KUEUE_BURST_DELTA_PACK=0`` forces the full walk every window."""
+    import os
+    import time
+    st = structure
+    dirty: set = set()
+    soft: dict = {}
+    force_full = False
+    for j in (getattr(queues, "pack_journal", None),
+              getattr(cache, "pack_journal", None)):
+        if j is None:
+            force_full = True
+        else:
+            force_full |= j.drain_into(dirty, soft)
+    enabled = os.environ.get("KUEUE_BURST_DELTA_PACK", "1") != "0"
+    key = (st.generation, st.resource_scale.tobytes(),
+           tuple(st.cq_names), window)
+
+    def _full():
+        if _unknown_active_cq(st, queues):
+            return None, None, False
+        records = _walk_records(st, queues, cache, scheduler, window)
+        if records is None:
+            return None, None, False
+        fields: dict = {}
+        plan = _assemble_plan(st, records, cache, scheduler, min_m,
+                              fields_out=fields if enabled else None)
+        if plan is None:
+            return None, None, False
+        if stats is not None:
+            stats["burst_full_packs"] = (
+                stats.get("burst_full_packs", 0) + 1)
+            stats["rows_repacked"] = (
+                stats.get("rows_repacked", 0)
+                + sum(r.n_rows for r in records))
+        return (plan,
+                DeltaPackState(key, records, fields) if enabled
+                else None,
+                False)
+
+    if not enabled or state is None or state.key != key or force_full:
+        return _full()
+
+    t0 = time.perf_counter()
+    index_of = st.cq_index
+    C = len(st.cq_names)
+    # a dirty CQ the structure doesn't know fails the pack exactly when
+    # the full walk would (active with pending work); clean unknown CQs
+    # were checked at state creation and only change through journaled
+    # mutators
+    for name in dirty | set(soft):
+        if name not in index_of:
+            q = queues.queue_for(name)
+            if q is not None and q.active and q.pending_active():
+                return None, None, False
+    # soft-dirty roundtrips: verify the packed dynamic bits still hold;
+    # escalate the CQ to a re-walk when they moved
+    for name, skeys in soft.items():
+        ci = index_of.get(name)
+        if ci is None or name in dirty:
+            continue
+        if not _roundtrips_clean(state.records[ci],
+                                 queues.queue_for(name),
+                                 cache.cluster_queue(name), skeys):
+            dirty.add(name)
+
+    # at full churn the per-CQ delta walk is a near-complete rebuild
+    # plus journal/roundtrip overhead — measurably slower than the
+    # straight full walk at north-star scale.  The floor keeps small
+    # packs on the delta path so its machinery stays exercised.
+    if len(dirty) > max(_DELTA_MIN_DIRTY_CQS, _DELTA_MAX_DIRTY_FRAC * C):
+        return _full()
+
+    records = list(state.records)
+    pos_of = {name: i for i, name in
+              enumerate(queues.cluster_queue_names())}
     assumed = cache.assumed_workloads
-    for ci, name in enumerate(st.cq_names):
-        cq_live = cache.cluster_queue(name)
-        if cq_live is None:
+    scale_of = {r: int(st.resource_scale[i])
+                for i, r in enumerate(st.resource_names)}
+    repacked = 0
+    for name in dirty:
+        ci = index_of.get(name)
+        if ci is None:
             continue
-        fg = int(forest_of_cq[ci])
-        for key, info in cq_live.workloads.items():
-            obj = info.obj
-            # assumed-but-applied workloads are normal candidates (the
-            # apply hook is synchronous here; a failed apply forgets the
-            # assumption before the cycle returns) — only a live evicted
-            # condition or a missing reservation breaks the modeled
-            # candidate ordering
-            if (obj.condition_true(WL_EVICTED)
-                    or obj.conditions.get(WL_QUOTA_RESERVED) is None):
-                forest_bad[fg] = True
-                continue
-            admitted_by_ci[ci].append(info)
-
-    from .packing import _bucket
-    # sticky minimum keeps M stable across re-packs as queues drain
-    # (every distinct M is a fresh XLA compilation)
-    rows_per_cq = max(len(m) + len(a) for m, a in
-                      zip(members_by_ci, admitted_by_ci))
-    M = max(_bucket(rows_per_cq, minimum=4), min_m)
-
-    wl_req = np.zeros((C, M, R), dtype=np.int32)
-    wl_rank = np.full((C, M), INF_I32, dtype=np.int32)
-    wl_cycle_rank = np.zeros((C, M), dtype=np.int32)
-    wl_prio = np.zeros((C, M), dtype=np.int32)
-    wl_uidrank = np.zeros((C, M), dtype=np.int32)
-    vec_ok = np.zeros((C, M), dtype=bool)
-    elig = np.zeros((C, M), dtype=bool)
-    parked = np.zeros((C, M), dtype=bool)
-    resume = np.zeros((C, M), dtype=bool)
-    adm = np.zeros((C, M), dtype=bool)
-    adm_seq = np.zeros((C, M), dtype=np.int32)
-    adm_usage = np.zeros((C, M, F), dtype=np.int32)
-    adm_uses = np.zeros((C, M, F), dtype=bool)
-    death = np.full((C, M), I32_MAX, dtype=np.int32)
-    keys: list[list] = [[None] * M for _ in range(C)]
-
-    scale = st.resource_scale
-    scale_is_one = st.scale_is_one
-    cq_ok = st.cq_vector_ok if st.cq_vector_ok is not None else np.zeros(C, bool)
-    gen = st.generation
-    scale_of = {r: int(scale[i]) for i, r in enumerate(st.resource_names)}
-
-    def usage_vec(info) -> Optional[tuple]:
-        return admitted_usage_vec(info, st, scale_of, F)
-
-    # flatten members with one Python pass; static per-workload facts
-    # (scaled request vector, shape eligibility) are cached on the Info
-    # object keyed by structure generation — requests are immutable per
-    # Info instance, so re-packs touch each workload only lightly
-    n_upper = n_pending + sum(len(a) for a in admitted_by_ci)
-    # list appends + one bulk conversion: per-element numpy scalar
-    # writes cost ~0.3us each and dominate the 100k-row pack
-    prio_l: list[int] = []
-    ts_l: list[float] = []
-    parked_l: list[bool] = []
-    adm_res_ts_l: list[float] = []    # per admitted row, in row order
-    ok_l: list[bool] = []
-    resume_l: list[bool] = []
-    key_a: list[str] = []
-    uid_a: list[str] = []
-    # per-CQ segments: (ci, pos, n_pending_rows, n_admitted_rows) —
-    # per-row constants come from np.repeat instead of per-row appends
-    seg_ci: list[int] = []
-    seg_pos: list[int] = []
-    seg_np: list[int] = []
-    seg_na: list[int] = []
-    req_mat = np.zeros((n_upper, R), dtype=np.int32)
-    usage_mat = np.zeros((n_upper, F), dtype=np.int32)
-    uses_mat = np.zeros((n_upper, F), dtype=bool)
-    qts = ordering.queue_order_timestamp
-    from ..api.types import AdmissionCheckState
-
-    i = 0
-    for ci in range(C):
-        mlist = members_by_ci[ci]
-        alist = admitted_by_ci[ci]
-        if not mlist and not alist:
-            continue
-        i_seg = i
-        cq_name = st.cq_names[ci]
-        cq_live = cache.cluster_queue(cq_name)
-        covers_pods = cq_name in st.cq_covers_pods
-        pos = cq_pos.get(cq_name, C)
-        cq_vec = bool(cq_ok[ci])
-        if cq_vec and cq_live is not None and cq_live.spec.namespace_selector:
-            cq_vec = False   # selector evaluation stays on the host path
-        lr_summaries = scheduler.limit_range_summaries
-        allocatable = (cq_live.allocatable_generation
-                       if cq_live is not None else -1)
-        pk = parked_by_ci[ci]
-        for info in mlist:
-            row = getattr(info, "_burst_row", None)
-            if row is None or row[0] != gen or row[1] != covers_pods:
-                row = (gen, *_static_row(info, st, covers_pods, qts))
-                info._burst_row = row
-            _, _, req_vec, static_ok, ts, prio, uid = row
-            key = info.key
-            key_a.append(key)
-            uid_a.append(uid)
-            prio_l.append(prio)
-            ts_l.append(ts)
-            parked_l.append(key in pk)
-            req_mat[i] = req_vec
-            ok = cq_vec and static_ok
-            if ok:
-                obj = info.obj
-                if lr_summaries and lr_summaries.get(obj.namespace):
-                    ok = False   # LimitRange bounds stay host-side
-                elif key in assumed or obj.admission is not None:
-                    ok = False
-                elif obj.admission_check_states and any(
-                        stt.state in (AdmissionCheckState.RETRY,
-                                      AdmissionCheckState.REJECTED)
-                        for stt in obj.admission_check_states.values()):
-                    ok = False
-            ok_l.append(ok)
-            last = info.last_assignment
-            resume_l.append(
-                last is not None
-                and getattr(last, "pending_flavors", False)
-                and last.cluster_queue_generation >= allocatable)
-            i += 1
-        for info in alist:
-            row = getattr(info, "_burst_row", None)
-            if row is None or row[0] != gen or row[1] != covers_pods:
-                row = (gen, *_static_row(info, st, covers_pods, qts))
-                info._burst_row = row
-            _, _, req_vec, static_ok, ts, prio, uid = row
-            uv = usage_vec(info)
-            if uv is None:
-                # not representable as a target/release row: the host
-                # handles its cycles (forest out of the envelope) and
-                # its finish via the ext_release path
-                forest_bad[int(forest_of_cq[ci])] = True
-                continue
-            key_a.append(info.key)
-            uid_a.append(uid)
-            prio_l.append(prio)
-            ts_l.append(ts)
-            parked_l.append(False)
-            obj = info.obj
-            cond = obj.conditions.get(WL_QUOTA_RESERVED)
-            adm_res_ts_l.append(cond.last_transition_time)
-            req_mat[i] = req_vec
-            usage_mat[i], uses_mat[i] = uv
-            # post-eviction afterlife: the same dynamic gates pending
-            # rows get (LimitRange bounds, failed admission checks) —
-            # an in-burst-evicted row the kernel re-admits must honor
-            # everything the host nominate would; gating extra is safe
-            # (the cycle goes dirty), gating less diverges decisions
-            ok = cq_vec and static_ok
-            if ok:
-                if lr_summaries and lr_summaries.get(obj.namespace):
-                    ok = False
-                elif obj.admission_check_states and any(
-                        stt.state in (AdmissionCheckState.RETRY,
-                                      AdmissionCheckState.REJECTED)
-                        for stt in obj.admission_check_states.values()):
-                    ok = False
-            ok_l.append(ok)
-            resume_l.append(False)
-            i += 1
-        seg_ci.append(ci)
-        seg_pos.append(pos)
-        seg_np.append(len(mlist))
-        seg_na.append(i - i_seg - len(mlist))
-    n = i
-    seg_np_a = np.array(seg_np, dtype=np.int64)
-    seg_na_a = np.array(seg_na, dtype=np.int64)
-    seg_rows = seg_np_a + seg_na_a
-    ci_a = np.repeat(np.array(seg_ci, dtype=np.int32), seg_rows)
-    pos_a = np.repeat(np.array(seg_pos, dtype=np.int32), seg_rows)
-    flags = np.zeros(2 * len(seg_ci), dtype=bool)
-    flags[1::2] = True   # each CQ: pending rows then admitted rows
-    adm_a = np.repeat(
-        flags, np.stack([seg_np_a, seg_na_a], axis=1).reshape(-1))
-    prio_a = np.array(prio_l, dtype=np.int64)
-    ts_a = np.array(ts_l, dtype=np.float64)
-    parked_a = np.array(parked_l, dtype=bool)
-    res_ts_a = np.zeros(n, dtype=np.float64)
-    res_ts_a[adm_a] = np.array(adm_res_ts_l, dtype=np.float64)
-    ok_a = np.array(ok_l, dtype=bool)
-    resume_a = np.array(resume_l, dtype=bool)
-    req_mat = req_mat[:n]
-    usage_mat = usage_mat[:n]
-    uses_mat = uses_mat[:n]
-
-    # heap rank within each CQ: one global lexsort replaces C Python
-    # sorts (priority desc, queue-order ts asc, key asc —
-    # cluster_queue.go:408).  Admitted rows get ranks too: a preempted
-    # target re-enters the heap at exactly this position (preemption
-    # evictions keep the creation-time ordering, workload.py:309).
-    key_arr = np.asarray(key_a)
-    order = np.lexsort((key_arr, ts_a, -prio_a, ci_a))
-    ci_sorted = ci_a[order]
-    first = np.ones(n, dtype=bool)
-    first[1:] = ci_sorted[1:] != ci_sorted[:-1]
-    seg_start = np.maximum.accumulate(
-        np.where(first, np.arange(n), 0))
-    mi_sorted = (np.arange(n) - seg_start).astype(np.int64)
-    mi_a = np.empty(n, dtype=np.int64)
-    mi_a[order] = mi_sorted
-    # global cycle-order rank (priority desc, ts asc, heads-position)
-    crank = np.empty(n, dtype=np.int64)
-    crank[np.lexsort((pos_a, ts_a, -prio_a))] = np.arange(n)
-    # uid rank (candidatesOrdering final tiebreak) + reservation-time
-    # dense rank (ties share a value; uid breaks them separately)
-    uidrank = np.empty(n, dtype=np.int64)
-    uidrank[np.argsort(np.asarray(uid_a), kind="stable")] = np.arange(n)
-    uniq_ts = np.unique(res_ts_a[adm_a]) if adm_a.any() else np.empty(0)
-    seq_a = np.zeros(n, dtype=np.int64)
-    if len(uniq_ts):
-        seq_a[adm_a] = np.searchsorted(uniq_ts, res_ts_a[adm_a]) + 1
-    seq_base = int(len(uniq_ts)) + 2
-
-    wl_rank[ci_a, mi_a] = mi_a
-    wl_cycle_rank[ci_a, mi_a] = crank
-    wl_prio[ci_a, mi_a] = np.clip(prio_a, -I32_MAX, I32_MAX)
-    wl_uidrank[ci_a, mi_a] = uidrank
-    parked[ci_a, mi_a] = parked_a
-    elig[ci_a, mi_a] = ~parked_a & ~adm_a
-    vec_ok[ci_a, mi_a] = ok_a
-    resume[ci_a, mi_a] = resume_a
-    wl_req[ci_a, mi_a] = req_mat
-    adm[ci_a, mi_a] = adm_a
-    adm_seq[ci_a, mi_a] = seq_a
-    adm_usage[ci_a, mi_a] = usage_mat
-    adm_uses[ci_a, mi_a] = uses_mat
-    row_of_key: dict = {}
-    for j in range(n):
-        keys[int(ci_a[j])][int(mi_a[j])] = key_a[j]
-        row_of_key[key_a[j]] = (int(ci_a[j]), int(mi_a[j]))
-
-    # CQ-level usage, scaled exactly (else no burst)
-    u_cq = np.zeros((C, F), dtype=np.int32)
-    for ci, name in enumerate(st.cq_names):
-        cq_live = cache.cluster_queue(name)
-        if cq_live is None:
-            return None
-        for fr, v in cq_live.resource_node.usage.items():
-            fi = st.fr_index.get(fr)
-            if fi is None:
-                return None
-            if scale_is_one:
-                q_ = int(v)
-            else:
-                s = int(scale[st.r_index[fr.resource]])
-                q_, rem = divmod(int(v), s)
-                if rem:
-                    return None
-            if q_ > I32_MAX:
-                return None
-            u_cq[ci, fi] = q_
-
-    # tree metadata
-    parent = st.parent
-    N = st.node_count
-    node_level = np.zeros(N, dtype=np.int32)
-    for ni in range(N):
-        lvl, p = 0, parent[ni]
-        while p >= 0:
-            lvl += 1
-            p = parent[p]
-        node_level[ni] = lvl
-    # node_level[ni] = distance from root (roots = 0); rebuild_usage
-    # sweeps deepest levels first via range(n_levels-1, 0, -1)
-    n_levels = int(node_level.max()) + 1
-    per_forest = np.bincount(forest_of_cq, minlength=G)
-    L = max(1, int(per_forest.max()))
-    members = build_members(forest_of_cq, G, L)
-
-    # preemption policy flags + the in-kernel modeling envelope
-    wcq_lower = np.zeros(C, dtype=bool)
-    rwc_enabled = np.zeros(C, dtype=bool)
-    rwc_only_lower = np.zeros(C, dtype=bool)
-    preempt_ok = np.zeros(C, dtype=bool)
-    cq_level = node_level[:C]
-    # forest depth > 2 (nested cohorts) is outside the envelope
-    deep = np.zeros(G, dtype=bool)
-    np.maximum.at(deep, forest_of_cq, cq_level > 1)
-    forest_bad |= deep
-    KC = min(KC_CAP, ((L * M + 31) // 32) * 32)
-    if L * M > KC:
-        forest_bad[:] = True
-    if not ordering.priority_sorting_within_cohort:
-        forest_bad[:] = True
-    # the kernel's composite candidate-ordering keys pack priority and
-    # reservation-seq into 20-bit fields and uid rank into 19; in-burst
-    # admissions consume seq_base..seq_base+K-1, so the headroom is the
-    # largest window the ladder can dispatch (not a hardcoded constant)
-    if (np.abs(prio_a).max(initial=0) >= (1 << 20)
-            or seq_base + max(K_BURST_LADDER) >= (1 << 20)
-            or n >= (1 << 19)):
-        forest_bad[:] = True
-    for ci, name in enumerate(st.cq_names):
-        cq_live = cache.cluster_queue(name)
-        if cq_live is None:
-            continue
-        pol = cq_live.spec.preemption
-        wcq_lower[ci] = (pol.within_cluster_queue
-                         == WithinClusterQueue.LOWER_PRIORITY)
-        rwc_enabled[ci] = (pol.reclaim_within_cohort
-                           != ReclaimWithinCohort.NEVER)
-        rwc_only_lower[ci] = (pol.reclaim_within_cohort
-                              == ReclaimWithinCohort.LOWER_PRIORITY)
-        modelable = (
-            pol.borrow_within_cohort.policy == BorrowWithinCohortPolicy.NEVER
-            and pol.within_cluster_queue
-            != WithinClusterQueue.LOWER_OR_NEWER_EQUAL_PRIORITY
-            and not forest_bad[int(forest_of_cq[ci])])
-        preempt_ok[ci] = modelable
-    cand_rows, cand_lmem, self_lmem = build_candidate_tables(
-        forest_of_cq, members, M, KC)
-
-    from .cycle import available_all_np
-    potential0 = np.minimum(available_all_np(
-        np.zeros((N, F), np.int64), st.subtree_quota, st.guaranteed,
-        st.borrow_cap, st.has_borrow_limit, st.parent, st.depth),
-        np.int64(I32_MAX)).astype(np.int32)
-
-    arrays = dict(
-        wl_req=wl_req, wl_rank=wl_rank, wl_cycle_rank=wl_cycle_rank,
-        wl_prio=wl_prio, wl_uidrank=wl_uidrank,
-        vec_ok=vec_ok, elig0=elig, parked0=parked, resume0=resume,
-        adm0=adm, adm_seq0=adm_seq, adm_usage0=adm_usage,
-        adm_uses0=adm_uses, death0=death,
-        u_cq0=u_cq, potential0=potential0,
-        subtree=st.subtree_quota, guaranteed=st.guaranteed,
-        borrow_cap=st.borrow_cap, has_blim=st.has_borrow_limit,
-        parent=st.parent, node_level=node_level,
-        nominal_cq=st.nominal_cq, npb_cq=st.nominal_plus_blimit_cq,
-        slot_fr=st.slot_fr, slot_valid=st.slot_valid,
-        cq_can_preempt_borrow=st.cq_can_preempt_borrow,
-        forest_of_cq=forest_of_cq, strict_cq=strict,
-        wcq_lower=wcq_lower, rwc_enabled=rwc_enabled,
-        rwc_only_lower=rwc_only_lower, preempt_ok=preempt_ok,
-        members=members, cand_rows=cand_rows, cand_lmem=cand_lmem,
-        self_lmem=self_lmem)
-    return BurstPlan(structure=st, arrays=arrays, keys=keys,
-                     C=C, M=M, L=L, G=G, n_levels=n_levels, KC=KC,
-                     seq_base=seq_base, row_of_key=row_of_key,
-                     max_res_ts=(float(res_ts_a[adm_a].max())
-                                 if adm_a.any() else None))
+        rec = _pack_cq_rows(st, ci, pos_of.get(name, C), queues, cache,
+                            scheduler, assumed, scale_of, window)
+        if rec is _PACK_FAIL:
+            return None, None, False
+        records[ci] = rec
+        repacked += rec.n_rows
+    # heads-enumeration positions can shift when CQs leave the queue
+    # manager; refresh them on every record (clean ones included)
+    for rec in records:
+        rec.pos = pos_of.get(st.cq_names[rec.ci], C)
+    fields: dict = {}
+    plan = _assemble_plan(st, records, cache, scheduler, min_m,
+                          prev=(state.records, state.fields),
+                          fields_out=fields)
+    if plan is None:
+        return None, None, False
+    if stats is not None:
+        stats["burst_delta_packs"] = (
+            stats.get("burst_delta_packs", 0) + 1)
+        stats["rows_repacked"] = (
+            stats.get("rows_repacked", 0) + repacked)
+        stats["rows_reused"] = (
+            stats.get("rows_reused", 0)
+            + sum(r.n_rows for r in records) - repacked)
+        stats["delta_pack_s"] = (
+            stats.get("delta_pack_s", 0.0) + time.perf_counter() - t0)
+    return plan, DeltaPackState(key, records, fields), True
 
 
 # one K rung: every distinct K is a full kernel compilation, and a
@@ -1437,7 +1790,12 @@ class BurstSolver:
                       "burst_serial_windows": 0,
                       "burst_spec_fetch_wait_s": 0.0,
                       # modeled preempt target vanished before apply
-                      "burst_target_divergences": 0}
+                      "burst_target_divergences": 0,
+                      # incremental delta-pack boundary (persistent
+                      # per-CQ row records; full repack on any miss)
+                      "burst_delta_packs": 0, "burst_full_packs": 0,
+                      "rows_reused": 0, "rows_repacked": 0,
+                      "delta_pack_s": 0.0}
 
     def _device(self):
         import jax

@@ -135,6 +135,31 @@ def _bucket(n: int, minimum: int = 8) -> int:
     return max(minimum, 1 << math.ceil(math.log2(max(1, n))))
 
 
+def scaled_usage_row(st: PackedStructure, cq_live) -> Optional[np.ndarray]:
+    """One CQ's live usage scaled onto the packed flavor-resource axis:
+    [F] int32, or None when not exactly representable (unknown
+    flavor-resource, a remainder under the scale, or int32 overflow) —
+    any None fails the whole burst pack, matching the host path."""
+    F = max(1, len(st.fr_index))
+    row = np.zeros(F, dtype=np.int32)
+    scale = st.resource_scale
+    for fr, v in cq_live.resource_node.usage.items():
+        fi = st.fr_index.get(fr)
+        if fi is None:
+            return None
+        if st.scale_is_one:
+            q_ = int(v)
+        else:
+            s = int(scale[st.r_index[fr.resource]])
+            q_, rem = divmod(int(v), s)
+            if rem:
+                return None
+        if q_ > I32_MAX:
+            return None
+        row[fi] = q_
+    return row
+
+
 def coarse_bucket(n: int, ladder: tuple[int, ...]) -> int:
     """Smallest ladder rung >= n (last rung if none).  Coarse ladders
     keep the number of DISTINCT compiled shapes small — each new shape

@@ -161,6 +161,8 @@ class CycleSolver:
         self.stats = {
             "full_cycles": 0,         # fully device-decided cycles
             "fs_full_cycles": 0,      # fair-sharing cycles decided in-scan
+            "fs_noop_skips": 0,       # FS cycles with no fit head: the
+                                      # tournament dispatch was skipped
             "classify_cycles": 0,     # device nominate + host admit loop
             "host_cycles": 0,         # pure host fallback (classify=None)
             "reserve_entries": 0,

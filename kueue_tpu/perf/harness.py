@@ -266,6 +266,14 @@ def burst_boundary_report(bstats: dict) -> dict:
         "spec_fetch_wait_s": round(
             bstats.get("burst_spec_fetch_wait_s", 0.0), 4),
         "target_divergences": bstats.get("burst_target_divergences", 0),
+        # incremental delta-pack (ops/burst.pack_burst_cached): windows
+        # whose boundary re-walked only journal-dirty CQs vs counted
+        # full-repack fallbacks, and the row-level reuse they bought
+        "delta_packs": bstats.get("burst_delta_packs", 0),
+        "full_packs": bstats.get("burst_full_packs", 0),
+        "rows_reused": bstats.get("rows_reused", 0),
+        "rows_repacked": bstats.get("rows_repacked", 0),
+        "delta_pack_s": round(bstats.get("delta_pack_s", 0.0), 4),
     }
 
 

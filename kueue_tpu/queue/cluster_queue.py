@@ -50,6 +50,15 @@ class ClusterQueueQueue:
         self.pop_cycle = 0
         self.queue_inadmissible_cycle = -1
         self.active = True  # mirrors CQ activeness (stop policies, missing refs)
+        # PackJournal shared with the queue manager (set on registration):
+        # mutators that can change this CQ's packed burst rows mark it
+        # dirty; pop/requeue roundtrips only soft-mark (utils/journal.py)
+        self.journal = None
+
+    def _touch(self) -> None:
+        j = self.journal
+        if j is not None:
+            j.touch(self.name)
 
     # ------------------------------------------------------------------
 
@@ -66,6 +75,10 @@ class ClusterQueueQueue:
     def push_or_update(self, info: Info) -> None:
         """reference cluster_queue.go PushOrUpdate (via AddOrUpdateWorkload)."""
         key = info.key
+        # even the `same` short-circuit swaps the stored Info for one
+        # with equal ordering facts but possibly different gate inputs
+        # (admission checks aren't compared) — always a hard touch
+        self._touch()
         self._forget_inflight(key)
         old = self.inadmissible.pop(key, None)
         if old is not None:
@@ -85,8 +98,14 @@ class ClusterQueueQueue:
         self.heap.push_or_update(info)
 
     def delete(self, key: str) -> None:
-        self.inadmissible.pop(key, None)
-        self.heap.delete(key)
+        parked = self.inadmissible.pop(key, None)
+        in_heap = self.heap.delete(key)
+        if parked is not None or in_heap:
+            # only when a tracked row actually left: the manager calls
+            # delete unconditionally for every removed workload, and
+            # dirtying CQs on finishes of never-queued workloads would
+            # defeat the delta pack
+            self._touch()
         self._forget_inflight(key)
 
     def requeue_if_not_present(self, info: Info, reason: RequeueReason) -> bool:
@@ -100,21 +119,37 @@ class ClusterQueueQueue:
 
     def _requeue_if_not_present(self, info: Info, immediate: bool) -> bool:
         key = info.key
+        was_inflight = (self.inflight is not None
+                        and self.inflight.key == key)
         self._forget_inflight(key)
         pending_flavors = (info.last_assignment is not None
                            and getattr(info.last_assignment, "pending_flavors", False))
+        j = self.journal
         if self.backoff_waiting_time_expired(info) and (
                 immediate or self.queue_inadmissible_cycle >= self.pop_cycle
                 or pending_flavors):
             parked = self.inadmissible.pop(key, None)
             if parked is not None:
                 info = parked
-            return self.heap.push_if_not_present(info)
+            pushed = self.heap.push_if_not_present(info)
+            if parked is not None or (pushed and not was_inflight):
+                # unpark or external (re)arrival: packed rows changed
+                self._touch()
+            elif j is not None:
+                # pop -> straight requeue: membership unchanged; only
+                # the parked/resume bits could move — soft-verified
+                j.note_roundtrip(self.name, key)
+            return pushed
         if key in self.inadmissible:
+            if j is not None:
+                j.note_roundtrip(self.name, key)
             return False
         if self.heap.get(key) is not None:
+            if j is not None:
+                j.note_roundtrip(self.name, key)
             return False
         self.inadmissible[key] = info
+        self._touch()
         return True
 
     def wake_expired_backoffs(self) -> bool:
@@ -125,6 +160,7 @@ class ClusterQueueQueue:
         every tick if it parks again."""
         moved = False
         still: dict[str, Info] = {}
+        before = len(self.inadmissible)
         for key, info in self.inadmissible.items():
             rs = info.obj.requeue_state
             if (rs is not None and rs.requeue_at is not None
@@ -138,6 +174,10 @@ class ClusterQueueQueue:
                 continue
             still[key] = info
         self.inadmissible = still
+        if moved or len(still) != before:
+            # a cleared requeue_at flips the row from pack-excluded to
+            # packed even when the heap already held it (moved False)
+            self._touch()
         return moved
 
     def queue_inadmissible_workloads(self) -> bool:
@@ -148,6 +188,7 @@ class ClusterQueueQueue:
             return False
         moved = False
         still_waiting: dict[str, Info] = {}
+        before = len(self.inadmissible)
         for key, info in self.inadmissible.items():
             if not self.backoff_waiting_time_expired(info):
                 still_waiting[key] = info
@@ -155,6 +196,8 @@ class ClusterQueueQueue:
             if self.heap.push_if_not_present(info):
                 moved = True
         self.inadmissible = still_waiting
+        if moved or len(still_waiting) != before:
+            self._touch()
         return moved
 
     def pop(self) -> Optional[Info]:

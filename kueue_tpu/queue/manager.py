@@ -14,6 +14,7 @@ from typing import Callable, Iterable, Optional
 
 from .. import hierarchy
 from ..api.types import ClusterQueue, LocalQueue, StopPolicy, Workload
+from ..utils.journal import PackJournal
 from ..workload import Info, InfoOptions, Ordering
 from .cluster_queue import ClusterQueueQueue, RequeueReason
 
@@ -40,6 +41,9 @@ class Manager:
         self._lq_members: dict[str, set[str]] = {}  # lq key -> workload keys
         self._wl_route: dict[str, str] = {}         # workload key -> lq key
         self.stopped = False
+        # dirty-CQ journal feeding the incremental burst pack; every
+        # registered ClusterQueueQueue shares it (utils/journal.py)
+        self.pack_journal = PackJournal()
 
     # ------------------------------------------------------------------
     # ClusterQueues / LocalQueues / Cohorts
@@ -55,6 +59,8 @@ class Manager:
             q = ClusterQueueQueue(spec.name, spec.queueing_strategy,
                                   self.ordering, self.clock)
             q.active = spec.stop_policy == StopPolicy.NONE
+            q.journal = self.pack_journal
+            self.pack_journal.touch(spec.name)
             self._mgr.add_cluster_queue(spec.name, q)
             self._mgr.update_cluster_queue_edge(spec.name, spec.cohort)
             self._cond.notify_all()
@@ -67,6 +73,7 @@ class Manager:
                 return
             q.queueing_strategy = spec.queueing_strategy
             q.active = spec.stop_policy == StopPolicy.NONE
+            self.pack_journal.touch(spec.name)
             self._mgr.update_cluster_queue_edge(spec.name, spec.cohort)
             if q.active:
                 q.queue_inadmissible_workloads()
@@ -74,6 +81,7 @@ class Manager:
 
     def delete_cluster_queue(self, name: str) -> None:
         with self._lock:
+            self.pack_journal.touch(name)
             self._mgr.delete_cluster_queue(name)
 
     def set_cluster_queue_active(self, name: str, active: bool) -> None:
@@ -81,6 +89,7 @@ class Manager:
             q = self._mgr.cluster_queues.get(name)
             if q is None:
                 return
+            self.pack_journal.touch(name)
             q.active = active
             if active:
                 q.queue_inadmissible_workloads()

@@ -366,6 +366,15 @@ class Scheduler:
             # otherwise device classification still replaces the
             # per-head flavor walk and the host tournament decides
             n = cls.n
+            if not (cls.fit_slot0[:n] >= 0).any():
+                # nothing can admit: fs_admit_scan's can_admit requires
+                # a fit slot, and the dispatch gate below already
+                # excludes preempt-capable heads — the tournament would
+                # decide nothing, so skip the device round-trip
+                solver.stats["fs_noop_skips"] += 1
+                solver.stats["classify_cycles"] += 1
+                self._assign_classified(deferred, cls, snapshot, set())
+                return None
             fs_handle = None
             if (not self._cycle_blocked
                     and not cls.scalar_mask[:n].any()

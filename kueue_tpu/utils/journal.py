@@ -1,0 +1,61 @@
+"""Between-window mutation journal feeding the incremental burst pack.
+
+The queue manager and the cache each own one journal; their mutators
+mark the ClusterQueues whose packed rows may have changed.  The burst
+pack (ops/burst.py pack_burst_cached) drains both journals at every
+window boundary and re-walks only the dirty CQs, reusing the persistent
+per-CQ row records for everything else.
+
+Two dirt grades keep the hot path clean:
+
+- ``touch``: the CQ's row set or row facts changed (arrival, deletion,
+  park/unpark, admission accounting) — the CQ must be re-walked.
+- ``note_roundtrip``: a head was popped and requeued straight back
+  (every scheduled head, every cycle).  The row set is unchanged; only
+  per-row dynamic facts (the flavor-resume bit, the parked bit) could
+  have moved, so the pack verifies those in O(1) per key instead of
+  re-walking the CQ.
+
+``touch_all`` covers global inputs the journal doesn't model per-CQ
+(e.g. LimitRange summaries).  A fresh journal starts dirty-all so the
+first pack is always a full walk.
+"""
+
+from __future__ import annotations
+
+
+class PackJournal:
+    __slots__ = ("dirty", "dirty_all", "soft")
+
+    def __init__(self):
+        self.dirty: set[str] = set()
+        self.soft: dict[str, set[str]] = {}
+        self.dirty_all = True
+
+    def touch(self, cq_name: str) -> None:
+        self.dirty.add(cq_name)
+
+    def touch_all(self) -> None:
+        self.dirty_all = True
+
+    def note_roundtrip(self, cq_name: str, key: str) -> None:
+        s = self.soft.get(cq_name)
+        if s is None:
+            s = self.soft[cq_name] = set()
+        s.add(key)
+
+    def drain_into(self, dirty: set, soft: dict) -> bool:
+        """Merge this journal's content into the caller's accumulators
+        and reset it; returns the dirty-all flag that was set."""
+        was_all = self.dirty_all
+        dirty |= self.dirty
+        for name, keys in self.soft.items():
+            acc = soft.get(name)
+            if acc is None:
+                soft[name] = set(keys)
+            else:
+                acc |= keys
+        self.dirty.clear()
+        self.soft.clear()
+        self.dirty_all = False
+        return was_all

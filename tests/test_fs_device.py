@@ -203,3 +203,27 @@ def test_fs_preemption_cycles_stay_host():
         assert h.admitted == v.admitted
         assert sorted(h.preempted_targets) == sorted(v.preempted_targets)
     assert dh.admitted_keys() == dd.admitted_keys()
+
+
+def test_fs_noop_cycle_skips_tournament_dispatch():
+    """A fair-sharing cycle where no head has a fit slot admits nothing;
+    the device tournament dispatch is skipped and counted, and the heads
+    still requeue as inadmissible exactly like the host path."""
+    def wls():
+        # 3000 > nominal 2000 + borrowing 0 on every slot: all nofit
+        return [mk(f"w-{q}", f"lq-0-{q}", 3000, t=float(q))
+                for q in range(3)]
+
+    dh, ch = build(fs_cluster(nominal=2000, borrowing=0), False)
+    dd, cd = build(fs_cluster(nominal=2000, borrowing=0), True)
+    for d in (dh, dd):
+        for wl in wls():
+            d.create_workload(wl)
+    host = run_cycles(dh, ch, 2)
+    dev = run_cycles(dd, cd, 2)
+    for h, v in zip(host, dev):
+        assert h.admitted == v.admitted == []
+        assert sorted(h.inadmissible) == sorted(v.inadmissible)
+    stats = dd.scheduler.solver.stats
+    assert stats["fs_noop_skips"] >= 1
+    assert stats["fs_full_cycles"] == 0
