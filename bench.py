@@ -25,6 +25,35 @@ import os
 import sys
 import time
 
+
+def _peek_shards(argv) -> int:
+    """--shards N (or --shards=N) from raw argv, before jax loads."""
+    n = 0
+    for i, a in enumerate(argv):
+        if a == "--shards" and i + 1 < len(argv):
+            try:
+                n = max(n, int(argv[i + 1]))
+            except ValueError:
+                pass
+        elif a.startswith("--shards="):
+            try:
+                n = max(n, int(a.split("=", 1)[1]))
+            except ValueError:
+                pass
+    return n
+
+
+# must run before the kueue_tpu imports below initialize jax: a CPU
+# host only gets a multi-device mesh via the host-count XLA flag
+_shards = _peek_shards(sys.argv[1:])
+if _shards > 1:
+    _xf = os.environ.get("XLA_FLAGS", "")
+    if "xla_force_host_platform_device_count" not in _xf:
+        os.environ["XLA_FLAGS"] = (
+            _xf + f" --xla_force_host_platform_device_count={_shards}"
+        ).strip()
+    os.environ.setdefault("KUEUE_TPU_SHARDS", str(_shards))
+
 from kueue_tpu.api.types import (
     ClusterQueue,
     FlavorQuotas,
@@ -304,6 +333,16 @@ def one_trial(scale: float):
     return out
 
 
+def _mesh_tail() -> dict:
+    """Self-describing mesh/shard block (n_devices, platform, shards)."""
+    import jax
+    devs = jax.devices()
+    return {"n_devices": len(devs),
+            "platform": devs[0].platform if devs else "none",
+            "shards": max(1, _shards or int(
+                os.environ.get("KUEUE_TPU_SHARDS", "0") or 0))}
+
+
 def main():
     if ("--require-accel" in sys.argv[1:]
             or os.environ.get("KUEUE_TPU_REQUIRE_ACCEL", "0")
@@ -380,8 +419,11 @@ def main():
             k: med["burst_stats"].get(k, 0)
             for k in ("burst_packs", "burst_delta_packs",
                       "burst_full_packs", "rows_reused",
-                      "rows_repacked", "delta_pack_s", "burst_pack_s")},
+                      "rows_repacked", "delta_pack_s", "burst_pack_s",
+                      "burst_sharded_dispatches")},
+        "mesh": _mesh_tail(),
         "fs_noop_skips": solver_stats.get("fs_noop_skips", 0),
+        "fs_noop_reuses": solver_stats.get("fs_noop_reuses", 0),
         "scenario_note": ("since r3: staggered arrival + real preemptions "
                           "(harder than r2's all-pending-at-t0; r2's 4898.7 "
                           "adm/s is not comparable)"),

@@ -101,6 +101,15 @@ class Driver:
             from ..ops.solver import CycleSolver
             self.scheduler.solver = CycleSolver(ordering,
                                                 backend=solver_backend)
+            shards = self._env_shards()
+            if shards > 1:
+                try:
+                    from ..parallel.sharded import make_mesh
+                    mesh = make_mesh(shards)
+                    if mesh is not None:
+                        self.scheduler.solver.set_mesh(mesh)
+                except Exception:
+                    pass  # fewer devices than asked: stay serial
         self.scheduler.apply_admission = self._apply_admission
         self.scheduler.preemptor.apply_preemption = self._apply_preemption
         if self.wait_for_pods_ready.enable and self.wait_for_pods_ready.block_admission:
@@ -116,6 +125,15 @@ class Driver:
         self._burst_solver = None   # lazy BurstSolver (ops/burst.py)
         self._burst_m = 0           # sticky M bucket across burst packs
         self._burst_pack_state = None  # persistent delta-pack records
+
+    @staticmethod
+    def _env_shards() -> int:
+        """KUEUE_TPU_SHARDS=N activates sharded dispatch (0/1 = serial)."""
+        import os
+        try:
+            return int(os.environ.get("KUEUE_TPU_SHARDS", "0") or 0)
+        except ValueError:
+            return 0
 
     @classmethod
     def from_config(cls, cfg, clock: Callable[[], float] = time.time,
@@ -688,6 +706,9 @@ class Driver:
                 and self.wait_for_pods_ready.block_admission))
         if self._burst_solver is None:
             self._burst_solver = BurstSolver(backend=backend)
+            shards = self._env_shards()
+            if shards > 1:
+                self._burst_solver.set_shards(shards)
         self._burst_solver.backend = backend
         solver = self.scheduler.solver
         normal_streak = 0   # cycles to run normally before re-bursting
@@ -868,6 +889,13 @@ class Driver:
             # inside or past the next window, or runtime > K (a PRE-pack
             # admission's finish could then land past this window — the
             # carry only models finishes of in-kernel admissions).
+            if os.environ.get("KUEUE_BURST_DEBUG"):
+                import sys as _sys
+                print(f"spec gate @cycle {base}: remaining={remaining} "
+                      f"K={K} runtime={runtime} "
+                      f"dirty={bool(np.asarray(dirty).any())} "
+                      f"ext_late={any(off >= base + K for off in ext)}",
+                      file=_sys.stderr)
             if (pipeline and remaining > K and runtime <= K
                     and not bool(np.asarray(dirty).any())
                     and not any(off >= base + K for off in ext)):

@@ -95,11 +95,7 @@ DIRTY_SCALAR = 2       # head outside vectorized-classify coverage
 DIRTY_RESUME = 4       # head with fungibility resume state
 
 
-@partial(
-    jax.jit,
-    static_argnames=("K", "depth", "L", "S", "KC", "n_levels", "G",
-                     "runtime"))
-def burst_cycles(
+def _burst_cycles(
     # dense workload state [C, M, ...] — pending AND admitted rows
     wl_req,          # [C, M, R] int32 scaled requests
     wl_rank,         # [C, M] int32 heap rank (INF_I32 = empty slot)
@@ -144,7 +140,7 @@ def burst_cycles(
     ext_release,     # [K, C, F] int32 non-row usage released at END of k
     ext_unpark,      # [K, G] bool forest unpark events at END of cycle k
     *, K: int, depth: int, L: int, S: int, KC: int,
-    n_levels: int, G: int, runtime: int,
+    n_levels: int, G: int, runtime: int, axis_name=None,
 ):
     """Run K fused admission cycles with in-kernel preemption.
 
@@ -293,14 +289,23 @@ def burst_cycles(
 
         dirty_c = has_head & ((has_preempt & ~pre_model)
                               | ~vec_ok[cidx, row] | resume[cidx, row])
-        dirty = jnp.any(dirty_c)
+        # dirty/dirty_reason are the kernel's ONLY cross-forest
+        # quantities (everything else is forest-local); under a sharded
+        # dispatch each device reduces its own forests and a psum —
+        # executed unconditionally every cycle, so all shards agree on
+        # collective trip counts — folds them into the global flags
+        dflags = jnp.stack([
+            jnp.any(dirty_c).astype(jnp.int32),
+            jnp.any(has_preempt & ~pre_model).astype(jnp.int32),
+            jnp.any(has_head & ~vec_ok[cidx, row]).astype(jnp.int32),
+            jnp.any(has_head & resume[cidx, row]).astype(jnp.int32)])
+        if axis_name is not None:
+            dflags = jax.lax.psum(dflags, axis_name)
+        dirty = dflags[0] > 0
         dirty_reason = (
-            jnp.any(has_preempt & ~pre_model).astype(jnp.int32)
-            * DIRTY_PREEMPT
-            + jnp.any(has_head & ~vec_ok[cidx, row]).astype(jnp.int32)
-            * DIRTY_SCALAR
-            + jnp.any(has_head & resume[cidx, row]).astype(jnp.int32)
-            * DIRTY_RESUME)
+            (dflags[1] > 0).astype(jnp.int32) * DIRTY_PREEMPT
+            + (dflags[2] > 0).astype(jnp.int32) * DIRTY_SCALAR
+            + (dflags[3] > 0).astype(jnp.int32) * DIRTY_RESUME)
 
         # -- nominate-time preemption searches (preemption.go:127-342) -
         def run_searches(_):
@@ -720,6 +725,15 @@ def burst_cycles(
     # rebased by -K, seq_base advanced) without a host re-pack
     return (head_row, kind, slot, borrows, tgt_words, dirty,
             dirty_reason, carry)
+
+
+# the public jitted entrypoint; ``axis_name`` stays None on the serial
+# path and names the mesh axis when the raw body runs inside the
+# shard_map wrapper (parallel.sharded.sharded_burst_fn)
+burst_cycles = partial(
+    jax.jit,
+    static_argnames=("K", "depth", "L", "S", "KC", "n_levels", "G",
+                     "runtime", "axis_name"))(_burst_cycles)
 
 
 def build_members(forest_of_cq: np.ndarray, n_forests: int,
@@ -1756,6 +1770,8 @@ class BurstHandle:
     carry: tuple = None          # final scan state (jax arrays)
     speculative: bool = False
     t_dispatch: float = 0.0
+    sharded: bool = False        # dispatched through the mesh path
+    layout: object = None        # BurstShardLayout of a sharded dispatch
 
 
 class BurstSolver:
@@ -1796,6 +1812,45 @@ class BurstSolver:
                       "burst_delta_packs": 0, "burst_full_packs": 0,
                       "rows_reused": 0, "rows_repacked": 0,
                       "delta_pack_s": 0.0}
+        # mesh-sharded dispatch (forest partition over a 1-D "cq" axis;
+        # parallel.sharded.BurstShardLayout) — off until set_shards(n>1)
+        self.n_shards = 1
+        self._shard_mesh = None
+        self._shard_layouts: dict = {}
+        self._sharded_fns: dict = {}
+
+    def set_shards(self, n: int):
+        """Shard burst dispatches across ``n`` devices: cohort forests
+        are partitioned over a 1-D ``("cq",)`` mesh and the fused kernel
+        runs under shard_map with the dirty reduction as a psum.
+        ``n <= 1`` (or too few devices for a mesh) keeps the serial
+        single-device path — graceful degradation, not an error."""
+        from ..parallel.sharded import make_burst_mesh
+        n = int(n or 0)
+        mesh = make_burst_mesh(n) if n > 1 else None
+        self.n_shards = mesh.devices.size if mesh is not None else 1
+        self._shard_mesh = mesh
+        self._shard_layouts = {}
+        self._sharded_fns = {}
+        if mesh is not None:
+            self.stats.setdefault("burst_sharded_dispatches", 0)
+            # per-shard timing vectors (list-valued stats): how long the
+            # host spent building each shard's block of the permuted
+            # inputs, and how long each shard's decision slice took to
+            # become ready at fetch
+            self.stats["burst_shard_pack_s"] = [0.0] * self.n_shards
+            self.stats["burst_shard_fetch_s"] = [0.0] * self.n_shards
+
+    def _layout_for(self, plan: BurstPlan):
+        from ..parallel.sharded import BurstShardLayout
+        st = plan.structure
+        key = (id(st), st.generation, plan.C, plan.M, plan.G, plan.L,
+               plan.KC)
+        lay = self._shard_layouts.get(key)
+        if lay is None:
+            lay = BurstShardLayout(plan, self.n_shards)
+            self._shard_layouts = {key: lay}   # one structure at a time
+        return lay
 
     def _device(self):
         import jax
@@ -1816,12 +1871,17 @@ class BurstSolver:
 
     def _launch(self, plan: BurstPlan, K: int, runtime: int,
                 ext_release, ext_unpark, state, seq_base: int,
-                speculative: bool) -> BurstHandle:
+                speculative: bool, permuted: bool = False) -> BurstHandle:
         """Issue one fused kernel call without blocking for results.
         ``state`` is the 9-tuple of *0 scan-state arrays (numpy for a
-        packed window, jax device arrays for a chained one)."""
+        packed window, jax device arrays for a chained one);
+        ``permuted`` marks a chained state already in shard layout."""
         import jax
         import time as _time
+        if self.n_shards > 1 and self._shard_mesh is not None:
+            return self._launch_sharded(plan, K, runtime, ext_release,
+                                        ext_unpark, state, seq_base,
+                                        speculative, permuted)
         st = plan.structure
         dev = self._device()
         a = plan.arrays
@@ -1861,6 +1921,73 @@ class BurstSolver:
                            seq_base=seq_base, dev=dev, pending=out,
                            speculative=speculative, t_dispatch=t0)
 
+    def _sharded_fn(self, plan: BurstPlan, layout, K: int, runtime: int):
+        from ..parallel.sharded import sharded_burst_fn
+        st = plan.structure
+        S = int(st.slot_fr.shape[1])
+        key = (K, st.depth, plan.L, S, plan.KC, plan.n_levels,
+               layout.Gs, runtime)
+        fn = self._sharded_fns.get(key)
+        if fn is None:
+            fn = sharded_burst_fn(
+                self._shard_mesh, K=K, depth=st.depth, L=plan.L, S=S,
+                KC=plan.KC, n_levels=plan.n_levels, G=layout.Gs,
+                runtime=max(0, runtime))
+            self._sharded_fns[key] = fn
+        return fn
+
+    def _launch_sharded(self, plan: BurstPlan, K: int, runtime: int,
+                        ext_release, ext_unpark, state, seq_base: int,
+                        speculative: bool, permuted: bool) -> BurstHandle:
+        """Mesh-sharded twin of the serial launch: plan tensors and scan
+        state are permuted into per-forest shard blocks (value-remapped
+        so every rank/slot the kernel compares is carried verbatim —
+        decisions stay bit-identical) and the shard_map-wrapped kernel
+        is dispatched once across the whole mesh."""
+        import time as _time
+        layout = self._layout_for(plan)
+        timers = self.stats.get("burst_shard_pack_s")
+        a = layout.plan_arrays(plan, timers)
+        if not permuted:
+            state = layout.permute_state(state, timers)
+        (elig0, parked0, resume0, adm0, adm_seq0, adm_usage0,
+         adm_uses0, death0, u_cq0) = state
+        extr, extu = layout.permute_ext(ext_release, ext_unpark)
+        fn = self._sharded_fn(plan, layout, K, runtime)
+        t0 = _time.perf_counter()
+        out = fn(
+            a["wl_req"], a["wl_rank"], a["wl_cycle_rank"],
+            a["wl_prio"], a["wl_uidrank"], a["vec_ok"],
+            elig0, parked0, resume0,
+            adm0, adm_seq0, adm_usage0,
+            adm_uses0, death0, np.int32(seq_base),
+            u_cq0,
+            a["potential0"], a["subtree"], a["guaranteed"],
+            a["borrow_cap"], a["has_blim"], a["parent"],
+            a["node_level"], a["nominal_cq"], a["npb_cq"],
+            a["slot_fr"], a["slot_valid"], a["cq_can_preempt_borrow"],
+            a["forest_of_cq"], a["strict_cq"],
+            a["wcq_lower"], a["rwc_enabled"], a["rwc_only_lower"],
+            a["preempt_ok"],
+            a["members"], a["cand_rows"], a["cand_lmem"],
+            a["self_lmem"],
+            extr, extu)
+        self.stats["burst_dispatches"] += 1
+        self.stats["burst_cycles_decided"] += K
+        self.stats["burst_sharded_dispatches"] = (
+            self.stats.get("burst_sharded_dispatches", 0) + 1)
+        if speculative:
+            self.stats["burst_spec_dispatches"] += 1
+        else:
+            self.stats["burst_serial_windows"] += 1
+        dev = self._shard_mesh.devices.flat[0]
+        if dev.platform != "cpu":
+            self.stats["burst_accel_dispatches"] += 1
+        return BurstHandle(plan=plan, K=K, runtime=runtime,
+                           seq_base=seq_base, dev=dev, pending=out,
+                           speculative=speculative, t_dispatch=t0,
+                           sharded=True, layout=layout)
+
     def dispatch(self, plan: BurstPlan, K: int, runtime: int,
                  ext_release: np.ndarray,
                  ext_unpark: np.ndarray) -> BurstHandle:
@@ -1884,6 +2011,11 @@ class BurstSolver:
         import jax.numpy as jnp
         if handle.carry is None:
             return None
+        # a carry from one dispatch mode can't chain into the other
+        # (sharded carries live in shard layout): force a re-pack
+        if handle.sharded != (self.n_shards > 1
+                              and self._shard_mesh is not None):
+            return None
         seq_base = handle.seq_base + handle.K
         # same headroom discipline as pack_burst's overflow gate
         if seq_base + max(K_BURST_LADDER) >= (1 << 20):
@@ -1896,7 +2028,7 @@ class BurstSolver:
                  adm_uses, death, u_cq)
         return self._launch(handle.plan, handle.K, handle.runtime,
                             ext_release, ext_unpark, state, seq_base,
-                            speculative=True)
+                            speculative=True, permuted=handle.sharded)
 
     def fetch(self, handle: BurstHandle):
         """Block for a dispatched window's decisions.  Returns the numpy
@@ -1910,7 +2042,32 @@ class BurstSolver:
         t0 = _time.perf_counter()
         out = handle.pending
         handle.carry = out[-1]
-        handle.decisions = tuple(jax.device_get(out[:-1]))
+        if handle.sharded:
+            # per-shard readiness: block each decision shard in device
+            # order and attribute the incremental wait to that shard
+            waits = self.stats.get("burst_shard_fetch_s")
+            if waits is not None:
+                try:
+                    shards = sorted(out[0].addressable_shards,
+                                    key=lambda sh: sh.device.id)
+                    for i, sh in enumerate(shards[:len(waits)]):
+                        t1 = _time.perf_counter()
+                        sh.data.block_until_ready()
+                        waits[i] += _time.perf_counter() - t1
+                except Exception:
+                    pass   # timing is best-effort, decisions are not
+            dec = tuple(jax.device_get(out[:-1]))
+            cp = handle.layout.cq_pos
+            # decisions come back in shard layout [K, S*Cs, ...]; the
+            # inverse permutation restores the global CQ axis.  tgt_words
+            # values need no remap: bit j of a CQ's word row refers to
+            # candidate slot j, and the local tables were value-remapped
+            # at identical slot positions.
+            handle.decisions = tuple(
+                [np.ascontiguousarray(d[:, cp]) for d in dec[:5]]
+                + [dec[5], dec[6]])
+        else:
+            handle.decisions = tuple(jax.device_get(out[:-1]))
         handle.pending = None
         dt = _time.perf_counter() - t0
         if handle.speculative:
@@ -1938,4 +2095,7 @@ class BurstSolver:
         import jax
         handle = self.dispatch(plan, K, runtime, ext_release, ext_unpark)
         decisions = self.fetch(handle)
-        return decisions + (jax.device_get(handle.carry[-1]),)
+        u_cq = jax.device_get(handle.carry[-1])
+        if handle.sharded:
+            u_cq = np.ascontiguousarray(u_cq[handle.layout.cq_pos])
+        return decisions + (u_cq,)

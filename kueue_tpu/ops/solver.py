@@ -163,6 +163,8 @@ class CycleSolver:
             "fs_full_cycles": 0,      # fair-sharing cycles decided in-scan
             "fs_noop_skips": 0,       # FS cycles with no fit head: the
                                       # tournament dispatch was skipped
+            "fs_noop_reuses": 0,      # no-op FS cycles whose per-head
+                                      # walks were fingerprint-reused
             "classify_cycles": 0,     # device nominate + host admit loop
             "host_cycles": 0,         # pure host fallback (classify=None)
             "reserve_entries": 0,
@@ -220,6 +222,7 @@ class CycleSolver:
         self._sharded_fns = {}
         self.stats.setdefault("sharded_dispatches", 0)
         self.stats.setdefault("sharded_preempt_dispatches", 0)
+        self.stats.setdefault("sharded_fs_dispatches", 0)
 
     def _sharded_for(self, depth: int):
         fns = self._sharded_fns.get(depth)
@@ -1104,14 +1107,30 @@ class CycleSolver:
         else:
             self.stats["cpu_dispatches"] += 1
         from ..profiling import annotation
+        fs_args = (packed.usage0, st.subtree_quota, statics.sq_mask,
+                   st.guaranteed, st.borrow_cap, st.has_borrow_limit,
+                   st.parent, statics.node_level, st.fair_weight_milli,
+                   statics.lendable_r, statics.onehot,
+                   statics.child_order, packed.wl_cq, u_e, nofit,
+                   packed.wl_priority, ts_rank, valid)
+        if self.mesh is not None:
+            # mesh-sharded FS tournament: the SAME jitted program,
+            # partitioned by GSPMD over (wl, cq) — integer DRS math and
+            # deterministic argmax tie-breaks make it bit-identical
+            key = ("fs", st.depth, statics.n_levels)
+            fn = self._sharded_fns.get(key)
+            if fn is None:
+                from ..parallel.sharded import fs_scan_fn
+                fn = fs_scan_fn(self.mesh, st.depth, statics.n_levels)
+                self._sharded_fns[key] = fn
+            self.stats["sharded_fs_dispatches"] = (
+                self.stats.get("sharded_fs_dispatches", 0) + 1)
+            with annotation("fs_admit_scan"):
+                handle.pending = ("fs", fn(*fs_args))
+            return handle
         with annotation("fs_admit_scan"), jax.default_device(dev):
             handle.pending = ("fs", fs_admit_scan(
-                packed.usage0, st.subtree_quota, statics.sq_mask,
-                st.guaranteed, st.borrow_cap, st.has_borrow_limit,
-                st.parent, statics.node_level, st.fair_weight_milli,
-                statics.lendable_r, statics.onehot, statics.child_order,
-                packed.wl_cq, u_e, nofit, packed.wl_priority, ts_rank,
-                valid, depth=st.depth, n_levels=statics.n_levels))
+                *fs_args, depth=st.depth, n_levels=statics.n_levels))
         return handle
 
     def fetch(self, handle: DispatchHandle) -> DeviceCycleFinal:

@@ -131,8 +131,329 @@ def admit_scan_fns(mesh: Mesh, depth: int):
 
 
 # ---------------------------------------------------------------------------
-# Multi-host (DCN) mesh layout
+# Sharded fair-sharing tournament (CycleSolver.set_mesh routing)
 # ---------------------------------------------------------------------------
+
+def fs_scan_fn(mesh: Mesh, depth: int, n_levels: int):
+    """A mesh-bound jitted fs_admit_scan with the standard shardings:
+    quota-plane node tensors over ``cq``, per-head entry tensors over
+    ``wl``, the tree-walk tables (parent/node_level/weights/child_order,
+    gathered at every tournament level) replicated.  GSPMD partitions
+    the SAME program the serial path jits — the W sequential rounds,
+    the argmax winner selection, and every integer DRS division are
+    unchanged — so decisions are bit-identical by construction."""
+    from ..ops.fs_scan import fs_admit_scan
+
+    node = NamedSharding(mesh, P("cq"))
+    rep = NamedSharding(mesh, P())
+    wl = NamedSharding(mesh, P("wl"))
+    # fs_admit_scan(usage0, subtree, sq_mask, guaranteed, borrow_cap,
+    #               has_blim, parent, node_level, weights, lendable_r,
+    #               onehot, child_order, wl_cq, u_e, nofit, prio,
+    #               ts_rank, valid)
+    in_shardings = (node, node, node, node, node, node,
+                    rep, rep, rep, node, rep, rep,
+                    wl, wl, wl, wl, wl, wl)
+    jf = jax.jit(
+        lambda *a: fs_admit_scan(*a, depth=depth, n_levels=n_levels),
+        in_shardings=in_shardings)
+    n_cq = int(mesh.shape["cq"])
+    n_wl = int(mesh.shape["wl"])
+
+    def call(usage0, subtree, sq_mask, guaranteed, borrow_cap, has_blim,
+             parent, node_level, weights, lendable_r, onehot,
+             child_order, wl_cq, u_e, nofit, prio, ts_rank, valid):
+        # GSPMD needs sharded dims divisible by their axis; pad nodes
+        # to inert rows (parent -1, zero quota, never on any entry's
+        # path) and heads to invalid rows (valid False, so they are
+        # never `remaining` and the extra rounds yield winner -1),
+        # then slice decisions back to the real head count
+        N, W = usage0.shape[0], wl_cq.shape[0]
+        Np = -(-N // n_cq) * n_cq
+        Wp = -(-W // n_wl) * n_wl
+
+        def pad(a, n, fill):
+            return np.concatenate(
+                [a, np.full((n - a.shape[0],) + a.shape[1:], fill,
+                            a.dtype)]) if n != a.shape[0] else a
+
+        args = (pad(usage0, Np, 0), pad(subtree, Np, 0),
+                pad(sq_mask, Np, False), pad(guaranteed, Np, 0),
+                pad(borrow_cap, Np, 0), pad(has_blim, Np, False),
+                pad(parent, Np, -1), pad(node_level, Np, 0),
+                pad(weights, Np, 1), pad(lendable_r, Np, 0),
+                onehot, pad(child_order, Np, 0),
+                pad(wl_cq, Wp, -1), pad(u_e, Wp, 0),
+                pad(nofit, Wp, True), pad(prio, Wp, 0),
+                pad(ts_rank, Wp, 0), pad(valid, Wp, False))
+        order, admitted, processed = jf(*args)
+        if Wp != W:
+            # winners fill rounds 0..n_valid-1 (< W); the padded tail
+            # is all -1, so the slice loses nothing
+            order, admitted, processed = (
+                order[:W], admitted[:W], processed[:W])
+        return order, admitted, processed
+
+    return call
+
+
+# ---------------------------------------------------------------------------
+# Sharded fused-burst dispatch (BurstSolver.set_shards routing)
+# ---------------------------------------------------------------------------
+
+def make_burst_mesh(n_devices: int):
+    """A 1-D ``("cq",)`` mesh for the forest-partitioned burst kernel,
+    or None when fewer than ``n_devices`` devices exist (the caller
+    degrades to the serial path)."""
+    if n_devices is None or n_devices < 2:
+        return None
+    devices = jax.devices()
+    if len(devices) < n_devices:
+        return None
+    return Mesh(np.asarray(devices[:n_devices]), axis_names=("cq",))
+
+
+_I32_MAX = np.int32(2**31 - 1)
+
+# pad fills per kernel input: a padded CQ row must never grow a head
+# (wl_rank=INF), never hold quota, and never enter any forest's member
+# or candidate tables — everything else about it is then inert
+_C_FILLS = {
+    "wl_req": 0, "wl_rank": _I32_MAX, "wl_cycle_rank": 0, "wl_prio": 0,
+    "wl_uidrank": 0, "vec_ok": False,
+    "elig0": False, "parked0": False, "resume0": False, "adm0": False,
+    "adm_seq0": 0, "adm_usage0": 0, "adm_uses0": False,
+    "death0": _I32_MAX, "u_cq0": 0,
+    "nominal_cq": 0, "npb_cq": 0, "slot_fr": -1, "slot_valid": False,
+    "cq_can_preempt_borrow": False, "strict_cq": False,
+    "wcq_lower": False, "rwc_enabled": False, "rwc_only_lower": False,
+    "preempt_ok": False, "self_lmem": 0,
+}
+_N_FILLS = {
+    "potential0": 0, "subtree": 0, "guaranteed": 0, "borrow_cap": 0,
+    "has_blim": False,
+}
+_STATE_FILLS = (False, False, False, False, 0, 0, False, _I32_MAX, 0)
+
+
+class BurstShardLayout:
+    """Forest-partition of a burst plan across a 1-D ``cq`` mesh.
+
+    Cohort forests are the fused kernel's independence boundary: every
+    comparison it makes (heads argmin, candidate ordering, the
+    entryOrdering sort, the admit scan's lanes) stays inside one forest,
+    and all ordering keys are host-precomputed GLOBAL ranks carried by
+    value — so partitioning whole forests onto shards, with the dirty
+    reduction as a psum, reproduces the serial decisions bit-for-bit.
+
+    The layout assigns forests to shards greedily (largest CQ count
+    first onto the least-loaded shard), gives every shard equally padded
+    local index spaces (Cs CQ slots, Gs forest rows, Ns = Cs + Hs quota
+    nodes with CQ nodes first — the kernel's ``usage[:C]`` convention),
+    and VALUE-REMAPS the member/candidate tables into local ids at
+    identical slot positions, so ``tgt_words`` bit j still means global
+    candidate slot j and the driver's apply path is untouched."""
+
+    def __init__(self, plan, n_shards: int):
+        a = plan.arrays
+        st = plan.structure
+        C, M, G, L, KC = plan.C, plan.M, plan.G, plan.L, plan.KC
+        S = int(n_shards)
+        self.n_shards = S
+        self.M = M
+        forest_of_cq = np.asarray(a["forest_of_cq"])
+        parent = np.asarray(a["parent"])
+        node_level = np.asarray(a["node_level"])
+        members = np.asarray(a["members"])
+        cand_rows = np.asarray(a["cand_rows"])
+        cand_lmem = np.asarray(a["cand_lmem"])
+        N = parent.shape[0]
+        forest_of_node = np.asarray(st.forest_of_node)
+
+        # greedy LPT: big forests first onto the least-loaded shard
+        counts = np.bincount(forest_of_cq, minlength=G)
+        load = [0] * S
+        forests_of: list[list[int]] = [[] for _ in range(S)]
+        for g in sorted(range(G), key=lambda g: (-int(counts[g]), g)):
+            s = min(range(S), key=lambda i: (load[i], i))
+            forests_of[s].append(g)
+            load[s] += int(counts[g])
+        for fl in forests_of:
+            fl.sort()
+        shard_of_forest = np.zeros(max(G, 1), dtype=np.int32)
+        local_forest = np.zeros(max(G, 1), dtype=np.int32)
+        for s, fl in enumerate(forests_of):
+            for j, g in enumerate(fl):
+                shard_of_forest[g] = s
+                local_forest[g] = j
+
+        cqs_of: list[list[int]] = [[] for _ in range(S)]
+        for s, fl in enumerate(forests_of):
+            for g in fl:
+                for cq in members[g]:
+                    if cq >= 0:
+                        cqs_of[s].append(int(cq))
+        cohorts_of: list[list[int]] = [[] for _ in range(S)]
+        for nd in range(C, N):
+            f = int(forest_of_node[nd])
+            s = int(shard_of_forest[f]) if 0 <= f < G else 0
+            cohorts_of[s].append(nd)
+
+        Cs = max(1, max(len(x) for x in cqs_of))
+        Gs = max(1, max(len(x) for x in forests_of))
+        Hs = max(len(x) for x in cohorts_of)
+        self.Cs, self.Gs, self.Ns = Cs, Gs, Cs + Hs
+        Ns = self.Ns
+
+        cq_perm = np.full((S, Cs), -1, dtype=np.int32)
+        cq_pos = np.zeros(C, dtype=np.int64)
+        local_cq = np.zeros(C, dtype=np.int32)
+        for s, cqs in enumerate(cqs_of):
+            for j, cq in enumerate(cqs):
+                cq_perm[s, j] = cq
+                cq_pos[cq] = s * Cs + j
+                local_cq[cq] = j
+        node_perm = np.full((S, Ns), -1, dtype=np.int32)
+        node_perm[:, :Cs] = cq_perm
+        local_node = np.zeros(N, dtype=np.int32)
+        local_node[:C] = local_cq
+        for s, cohs in enumerate(cohorts_of):
+            for j, nd in enumerate(cohs):
+                node_perm[s, Cs + j] = nd
+                local_node[nd] = Cs + j
+        forest_perm = np.full((S, Gs), -1, dtype=np.int32)
+        for s, fl in enumerate(forests_of):
+            for j, g in enumerate(fl):
+                forest_perm[s, j] = g
+        self.cq_perm = cq_perm
+        self.cq_pos = cq_pos
+        self.node_perm = node_perm
+        self.forest_perm = forest_perm
+
+        # value-remapped static tables (slot positions preserved)
+        members_l = np.full((S * Gs, L), -1, dtype=np.int32)
+        cand_rows_l = np.full((S * Gs, KC), -1, dtype=np.int32)
+        cand_lmem_l = np.zeros((S * Gs, KC), dtype=np.int32)
+        for s, fl in enumerate(forests_of):
+            for j, g in enumerate(fl):
+                r = s * Gs + j
+                mrow = members[g]
+                mv = mrow >= 0
+                members_l[r][mv] = local_cq[mrow[mv]]
+                crow = cand_rows[g]
+                cv = crow >= 0
+                crs = crow[cv]
+                cand_rows_l[r][cv] = (local_cq[crs // M] * M
+                                      + crs % M).astype(np.int32)
+                cand_lmem_l[r] = cand_lmem[g]
+        parent_l = np.full(S * Ns, -1, dtype=np.int32)
+        node_level_l = np.zeros(S * Ns, dtype=np.int32)
+        flat_nodes = node_perm.ravel()
+        nv = flat_nodes >= 0
+        pv = parent[flat_nodes[nv]]
+        parent_l[nv] = np.where(pv >= 0, local_node[np.maximum(pv, 0)],
+                                -1).astype(np.int32)
+        node_level_l[nv] = node_level[flat_nodes[nv]]
+        forest_of_cq_l = np.zeros(S * Cs, dtype=np.int32)
+        fc = cq_perm.ravel()
+        cvv = fc >= 0
+        forest_of_cq_l[cvv] = local_forest[forest_of_cq[fc[cvv]]]
+        self._static = {
+            "members": members_l, "cand_rows": cand_rows_l,
+            "cand_lmem": cand_lmem_l, "parent": parent_l,
+            "node_level": node_level_l, "forest_of_cq": forest_of_cq_l,
+        }
+
+    # -- per-shard-timed permutation helpers ---------------------------
+    def _permute(self, src, perm, fill, timers):
+        import time as _time
+        S, B = perm.shape
+        out = np.full((S * B,) + src.shape[1:], fill, dtype=src.dtype)
+        for s in range(S):
+            t0 = _time.perf_counter()
+            row = perm[s]
+            v = row >= 0
+            out[s * B:(s + 1) * B][v] = src[row[v]]
+            if timers is not None and s < len(timers):
+                timers[s] += _time.perf_counter() - t0
+        return out
+
+    def permute_rows(self, arr, fill=0, timers=None):
+        """[C, ...] → [S*Cs, ...] (pad rows filled)."""
+        return self._permute(np.asarray(arr), self.cq_perm, fill, timers)
+
+    def permute_nodes(self, arr, fill=0, timers=None):
+        """[N, ...] → [S*Ns, ...] (CQ nodes first per shard)."""
+        return self._permute(np.asarray(arr), self.node_perm, fill,
+                             timers)
+
+    def permute_state(self, state, timers=None):
+        """The 9-tuple of scan-state arrays, global → shard layout."""
+        return tuple(
+            self.permute_rows(arr, fill, timers)
+            for arr, fill in zip(state, _STATE_FILLS))
+
+    def permute_ext(self, ext_release, ext_unpark):
+        """Event schedules [K, C, F] / [K, G] → shard layout on axis 1."""
+        def ax1(arr, perm, fill):
+            flat = perm.ravel()
+            out = np.full((arr.shape[0], flat.size) + arr.shape[2:],
+                          fill, dtype=arr.dtype)
+            v = flat >= 0
+            out[:, v] = arr[:, flat[v]]
+            return out
+        return (ax1(np.asarray(ext_release), self.cq_perm, 0),
+                ax1(np.asarray(ext_unpark), self.forest_perm, False))
+
+    def plan_arrays(self, plan, timers=None):
+        """The permuted kernel-input dict for ``plan``, cached on the
+        plan object (chained windows reuse it untouched)."""
+        cached = getattr(plan, "_shard_arrays", None)
+        if cached is not None and cached[0] is self:
+            return cached[1]
+        a = plan.arrays
+        out = dict(self._static)
+        for name, fill in _C_FILLS.items():
+            if name in ("self_lmem",):
+                out[name] = self.permute_rows(a[name], fill, timers)
+                continue
+            if name in ("elig0", "parked0", "resume0", "adm0",
+                        "adm_seq0", "adm_usage0", "adm_uses0",
+                        "death0", "u_cq0"):
+                continue   # scan state flows through permute_state
+            out[name] = self.permute_rows(a[name], fill, timers)
+        for name, fill in _N_FILLS.items():
+            out[name] = self.permute_nodes(a[name], fill, timers)
+        plan._shard_arrays = (self, out)
+        return out
+
+
+def sharded_burst_fn(mesh: Mesh, *, K: int, depth: int, L: int, S: int,
+                     KC: int, n_levels: int, G: int, runtime: int):
+    """shard_map-wrapped fused burst kernel over the 1-D ``cq`` axis.
+
+    Every input whose leading axis is CQ-, node- or forest-indexed is
+    split across shards; the event schedules split on axis 1; seq_base
+    is replicated.  The per-cycle decision planes come back concatenated
+    on the CQ axis, the dirty flags replicated (the kernel psums them),
+    and the final carry stays sharded on device for window chaining."""
+    from functools import partial as _partial
+    try:
+        from jax.experimental.shard_map import shard_map
+    except ImportError:  # pragma: no cover - newer JAX moved it
+        from jax.shard_map import shard_map
+    from ..ops.burst import _burst_cycles
+
+    row = P("cq")
+    rep = P()
+    kc = P(None, "cq")
+    in_specs = (row,) * 14 + (rep,) + (row,) * 23 + (kc, kc)
+    out_specs = (kc, kc, kc, kc, kc, rep, rep, (row,) * 9)
+    body = _partial(_burst_cycles, K=K, depth=depth, L=L, S=S, KC=KC,
+                    n_levels=n_levels, G=G, runtime=runtime,
+                    axis_name="cq")
+    return jax.jit(shard_map(body, mesh=mesh, in_specs=in_specs,
+                             out_specs=out_specs, check_rep=False))
 
 def make_hybrid_mesh(n_hosts: int | None = None, devices=None) -> Mesh:
     """A two-tier (wl, cq) mesh laid out so collective traffic matches

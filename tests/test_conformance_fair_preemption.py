@@ -592,3 +592,150 @@ def test_single_larger_candidate_for_two_unit_incoming(use_device):
     units(d, "c", ["c1"])
     incoming(d, "c-incoming", "c", {"cpu": 2 * K})
     assert preempted(cycle(d, clock)) == {"b-big"}
+
+
+# ========================================================================
+# Third table: cohort-borrowing × FS-preemption × sharded-dispatch grid.
+# Every row below runs in three modes — host, device, and device with
+# the solver routed through an 8-way (wl, cq) mesh on the conftest's
+# virtual CPU devices — and the `want` sets must hold in all three:
+# sharded dispatch is a deployment choice, never a semantics change.
+# ========================================================================
+
+
+@pytest.fixture(params=["host", "device", "sharded"])
+def fs_mode(request):
+    return request.param
+
+
+def make_driver_mode(mode):
+    d, clock = make_driver(use_device=(mode != "host"))
+    if mode == "sharded":
+        import jax
+        if len(jax.devices()) < 8:
+            pytest.skip("needs 8 virtual devices (conftest XLA flag)")
+        from kueue_tpu.parallel.sharded import make_mesh
+        d.scheduler.solver.set_mesh(make_mesh(8))
+    return d, clock
+
+
+# --- "reclaim one unit from the biggest borrower, deeper imbalance" -----
+
+def test_sharded_reclaim_from_deeper_borrower(fs_mode):
+    d, clock = make_driver_mode(fs_mode)
+    units(d, "a", ["a1", "a2"])
+    units(d, "b", ["b1", "b2", "b3", "b4", "b5", "b6"])
+    units(d, "c", ["c1"])
+    incoming(d, "c-incoming", "c", {"cpu": 1 * K})
+    assert preempted(cycle(d, clock)) == {"b1"}
+
+
+# --- "reclaim two units from the sole borrower" -------------------------
+
+def test_sharded_reclaim_two_from_sole_borrower(fs_mode):
+    d, clock = make_driver_mode(fs_mode)
+    units(d, "a", ["a1"])
+    units(d, "b", ["b1", "b2", "b3", "b4", "b5", "b6"])
+    units(d, "c", ["c1", "c2"])
+    incoming(d, "a-incoming", "a", {"cpu": 2 * K})
+    assert preempted(cycle(d, clock)) == {"b1", "b2"}
+
+
+# --- "borrowing incoming preempts two from a deep sub-threshold
+#      borrower (a's post-borrow share stays strictly under p's)" --------
+
+def test_sharded_borrowing_preempts_two_below_threshold(fs_mode):
+    d, clock = make_driver_mode(fs_mode)
+    units(d, "a", ["a1", "a2", "a3"])
+    units(d, "b", ["b1"])
+    units(d, "c", ["c1"])
+    units(d, "preemptible", ["p1", "p2", "p3", "p4"], priority=-4)
+    incoming(d, "a-incoming", "a", {"cpu": 2 * K})
+    assert preempted(cycle(d, clock)) == {"p1", "p2"}
+
+
+# --- "while borrowing, the FS share strategies arbitrate — the
+#      borrowWithinCohort priority threshold does not shield a deeper
+#      borrower above it" ------------------------------------------------
+
+def test_sharded_fs_strategies_override_borrow_threshold(fs_mode):
+    d, clock = make_driver_mode(fs_mode)
+    units(d, "a", ["a1", "a2", "a3"])
+    units(d, "b", ["b1", "b2", "b3"])
+    units(d, "c", ["c1"])
+    units(d, "preemptible", ["p1", "p2"], priority=-2)
+    incoming(d, "a-incoming", "a", {"cpu": 1 * K})
+    assert preempted(cycle(d, clock)) == {"p1"}
+
+
+# --- "reclaim targets the only borrowing CQ even when small" ------------
+
+def test_sharded_reclaim_targets_only_borrower(fs_mode):
+    d, clock = make_driver_mode(fs_mode)
+    units(d, "a", ["a1", "a2", "a3"])
+    units(d, "b", ["b1", "b2", "b3", "b4"])
+    units(d, "c", ["c1", "c2"])
+    incoming(d, "c-incoming", "c", {"cpu": 1 * K})
+    assert preempted(cycle(d, clock)) == {"b1"}
+
+
+# --- "borrowing incoming with no sub-threshold candidates is blocked" ---
+
+def test_sharded_borrowing_incoming_blocked_without_candidates(fs_mode):
+    d, clock = make_driver_mode(fs_mode)
+    units(d, "a", ["a1", "a2", "a3"])
+    units(d, "b", ["b1", "b2", "b3", "b4", "b5"])
+    units(d, "c", ["c1"])
+    incoming(d, "c-incoming", "c", {"cpu": 3 * K})
+    stats = cycle(d, clock)
+    assert not stats.admitted and not preempted(stats)
+
+
+# --- "reclaim picks the bigger borrower over the preemptible CQ" --------
+
+def test_sharded_reclaim_prefers_bigger_borrower_over_preemptible(fs_mode):
+    d, clock = make_driver_mode(fs_mode)
+    units(d, "a", ["a1", "a2", "a3"])
+    units(d, "b", ["b1", "b2", "b3", "b4", "b5"])
+    units(d, "preemptible", ["p1"], priority=-4)
+    incoming(d, "c-incoming", "c", {"cpu": 1 * K})
+    assert preempted(cycle(d, clock)) == {"b1"}
+
+
+# --- "huge preemptible workload reclaimed when it is the only option" ---
+
+def test_sharded_huge_preemptible_reclaimed(fs_mode):
+    d, clock = make_driver_mode(fs_mode)
+    units(d, "a", ["a1", "a2", "a3"])
+    admit(d, "p-big", "preemptible", {"cpu": ("default", 6 * K)},
+          priority=-4)
+    incoming(d, "c-incoming", "c", {"cpu": 2 * K})
+    assert preempted(cycle(d, clock)) == {"p-big"}
+
+
+# --- "two-unit reclaim equalizes across equal borrowers" ----------------
+
+def test_sharded_two_unit_reclaim_equalizes_borrowers(fs_mode):
+    d, clock = make_driver_mode(fs_mode)
+    units(d, "a", ["a1"])
+    units(d, "b", ["b1", "b2", "b3", "b4"])
+    units(d, "c", ["c1", "c2", "c3", "c4"])
+    incoming(d, "a-incoming", "a", {"cpu": 2 * K})
+    assert preempted(cycle(d, clock)) == {"b1", "c1"}
+
+
+# --- "reclaim converges and the incoming admits without flapping" -------
+
+def test_sharded_reclaim_converges_without_flapping(fs_mode):
+    d, clock = make_driver_mode(fs_mode)
+    units(d, "a", ["a1", "a2", "a3"])
+    units(d, "b", ["b1", "b2", "b3", "b4", "b5", "b6"])
+    incoming(d, "c-incoming", "c", {"cpu": 2 * K})
+    s1 = cycle(d, clock)
+    assert preempted(s1) == {"b1", "b2"}
+    admitted = set()
+    for _ in range(4):
+        s = cycle(d, clock)
+        admitted.update(s.admitted)
+        assert not preempted(s)
+    assert "default/c-incoming" in admitted

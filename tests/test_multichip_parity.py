@@ -1,0 +1,157 @@
+"""Multichip decision parity on the conftest's 8 virtual CPU devices
+(--xla_force_host_platform_device_count=8): an 8-shard dispatch of the
+fused burst window and of the FS tournament must be bit-identical to
+the serial single-device path — the tentpole's correctness bar, CI-
+testable without accelerator hardware.
+"""
+
+from __future__ import annotations
+
+import jax
+import pytest
+
+from kueue_tpu.controller.driver import Driver
+from kueue_tpu.ops.burst import BurstSolver
+from kueue_tpu.parallel.sharded import make_burst_mesh, make_mesh
+
+from test_burst import add_workloads, build, mk, run_host, simple_cluster
+from test_burst_pipeline import (
+    PRE_ANY,
+    assert_records_equal,
+    run_burst_mode,
+    run_host_inject,
+    sustained_spec,
+)
+from test_fs_device import build as fs_build
+from test_fs_device import fs_cluster
+from test_fs_device import mk as fs_mk
+from test_fs_device import run_cycles as fs_run_cycles
+
+needs_8_devices = pytest.mark.skipif(
+    len(jax.devices()) < 8, reason="needs 8 virtual devices (conftest)")
+
+
+def run_burst_shards(d, clock, cycles, runtime, shards, inject=None):
+    bs = BurstSolver(backend="cpu")
+    if shards > 1:
+        bs.set_shards(shards)
+        assert bs.n_shards == shards, bs.n_shards
+    d._burst_solver = bs
+    return run_burst_mode(d, clock, cycles, runtime, pipeline=True,
+                          inject=inject)
+
+
+@needs_8_devices
+def test_burst_8shard_vs_serial_admit_parity():
+    """Sustained multi-window drain: 8-shard == serial == host,
+    per-cycle, with the sharded kernel actually dispatched."""
+    spec = sustained_spec()
+    dh, ch = build(spec)
+    ds, cs = build(spec)
+    dp, cp = build(spec)
+    host = run_host(dh, ch, 80, 2)
+    serial = run_burst_shards(ds, cs, 80, 2, shards=0)
+    shard = run_burst_shards(dp, cp, 80, 2, shards=8)
+    assert len(serial) == len(shard)
+    assert_records_equal(serial, shard, "serial-vs-8shard")
+    assert_records_equal(host[:len(shard)], shard, "host-vs-8shard")
+    assert dh.admitted_keys() == ds.admitted_keys() == dp.admitted_keys()
+    st = dp._burst_solver.stats
+    assert st["burst_sharded_dispatches"] >= 1, st
+    assert len(st["burst_shard_pack_s"]) == 8
+    assert len(st["burst_shard_fetch_s"]) == 8
+
+
+@needs_8_devices
+def test_burst_8shard_vs_serial_preempt_parity():
+    """A mid-burst high-priority arrival forces the preemption boundary
+    (dirty window) on both arms; decisions — including preempted
+    targets — must stay bit-identical."""
+    wls = []
+    n = 0
+    for c in range(2):
+        for q in range(2):
+            for i in range(6):
+                n += 1
+                wls.append(mk(f"w-{c}-{q}-{i}", f"lq-{c}-{q}", 2000,
+                              prio=10, t=float(n)))
+    spec = add_workloads(
+        simple_cluster(n_cohorts=2, cqs=2, nominal=4000,
+                       borrowing=4000, preemption=PRE_ANY), wls)
+    inject = {6: mk("hi-a", "lq-0-0", 4000, prio=100, t=100.0),
+              9: mk("hi-b", "lq-1-1", 4000, prio=100, t=101.0)}
+    dh, ch = build(spec)
+    ds, cs = build(spec)
+    dp, cp = build(spec)
+    host = run_host_inject(dh, ch, 40, 3, inject=inject)
+    serial = run_burst_shards(ds, cs, 40, 3, shards=0, inject=inject)
+    shard = run_burst_shards(dp, cp, 40, 3, shards=8, inject=inject)
+    assert len(serial) == len(shard)
+    assert_records_equal(serial, shard, "serial-vs-8shard")
+    assert_records_equal(host[:len(shard)], shard, "host-vs-8shard")
+    assert any(s.preempted_targets for s in shard), \
+        "scenario produced no preemption"
+    assert dh.admitted_keys() == ds.admitted_keys() == dp.admitted_keys()
+    assert dp._burst_solver.stats["burst_sharded_dispatches"] >= 1
+
+
+@needs_8_devices
+def test_fs_tournament_8shard_vs_serial_parity():
+    """The FS tournament routed through the 8-device mesh must decide
+    identically to the unmeshed device path and to the host."""
+    wls = [fs_mk(f"w-{q}-{i}", f"lq-0-{q}", 1500, t=float(q * 10 + i))
+           for q in range(3) for i in range(8)]
+    spec = fs_cluster(weights=(1.0, 2.0, 0.5), nominal=2000,
+                      borrowing=8000)
+    dh, ch = fs_build(spec, use_device=False)
+    ds, cs = fs_build(spec, use_device=True)
+    dm, cm = fs_build(spec, use_device=True)
+    dm.scheduler.solver.set_mesh(make_mesh(8))
+    for d in (dh, ds, dm):
+        for wl in wls:
+            d.create_workload(wl)
+    host = fs_run_cycles(dh, ch, 12, runtime=3)
+    serial = fs_run_cycles(ds, cs, 12, runtime=3)
+    mesh = fs_run_cycles(dm, cm, 12, runtime=3)
+    for k, (h, s, m) in enumerate(zip(host, serial, mesh)):
+        assert h.admitted == s.admitted == m.admitted, \
+            f"cycle {k}: host={h.admitted} serial={s.admitted} " \
+            f"mesh={m.admitted}"
+        assert sorted(h.skipped) == sorted(s.skipped) == \
+            sorted(m.skipped), f"cycle {k} skipped"
+    assert dh.admitted_keys() == ds.admitted_keys() == dm.admitted_keys()
+    assert dm.scheduler.solver.stats["fs_full_cycles"] > 0
+    assert dm.scheduler.solver.stats["sharded_fs_dispatches"] >= 1, \
+        dm.scheduler.solver.stats
+
+
+@needs_8_devices
+def test_env_var_activates_sharding(monkeypatch):
+    """KUEUE_TPU_SHARDS=8 is the production switch: the driver must
+    wire both the cycle-solver mesh and the burst shards, and decisions
+    must match the serial run."""
+    monkeypatch.setenv("KUEUE_TPU_SHARDS", "8")
+    spec = sustained_spec(per_cq=18)
+    de, ce = build(spec)
+    assert de.scheduler.solver.mesh is not None
+    env = run_burst_mode(de, ce, 40, 2, pipeline=True)
+    monkeypatch.delenv("KUEUE_TPU_SHARDS")
+    ds, cs = build(spec)
+    serial = run_burst_mode(ds, cs, 40, 2, pipeline=True)
+    assert len(env) == len(serial)
+    assert_records_equal(serial, env, "serial-vs-env8")
+    assert de.admitted_keys() == ds.admitted_keys()
+    assert de._burst_solver.stats["burst_sharded_dispatches"] >= 1
+
+
+def test_burst_mesh_degrades_below_two_devices():
+    """make_burst_mesh(1) is None and set_shards(1) keeps the serial
+    path — graceful degradation on a 1-device mesh."""
+    assert make_burst_mesh(1) is None
+    assert make_burst_mesh(0) is None
+    bs = BurstSolver(backend="cpu")
+    bs.set_shards(1)
+    assert bs.n_shards == 1
+    assert bs._shard_mesh is None
+    bs.set_shards(10 ** 6)   # more shards than devices: stay serial
+    assert bs.n_shards == 1

@@ -1,0 +1,141 @@
+"""1-device mesh parity for kueue_tpu/parallel/sharded.py: routing the
+solver through a mesh of a single device must be bit-identical to the
+unsharded path across admit, preempt, and FS cycles — the degenerate
+end of the sharding contract (the 8-device end lives in
+test_multichip_parity.py), parametrized over the same random scenarios
+as tests/test_solver_parity.py.
+"""
+
+from __future__ import annotations
+
+import pytest
+
+from kueue_tpu.api.types import (
+    ClusterQueue,
+    FlavorQuotas,
+    LocalQueue,
+    PodSet,
+    PreemptionPolicy,
+    ReclaimWithinCohort,
+    ResourceFlavor,
+    ResourceGroup,
+    ResourceQuota,
+    WithinClusterQueue,
+    Workload,
+)
+from kueue_tpu.controller.driver import Driver
+from kueue_tpu.parallel.sharded import make_mesh
+
+from tests.conftest import FakeClock
+from tests.test_solver_parity import build_driver
+
+
+def _admitted_assignments(d):
+    admitted = {}
+    for k in d.admitted_keys():
+        wl = d.workload(k)
+        admitted[k] = tuple(sorted(
+            (a.name, a.count, tuple(sorted(a.flavors.items())))
+            for a in wl.admission.pod_set_assignments))
+    return admitted
+
+
+@pytest.mark.parametrize("seed", [1, 2, 3])
+def test_admit_parity_mesh1_vs_unsharded(seed):
+    """Same random scenarios as test_solver_parity's end-to-end parity,
+    with the device arm routed through a 1-device mesh."""
+    results = []
+    for mesh in (None, make_mesh(1)):
+        d, workloads = build_driver(seed, use_device_solver=True)
+        if mesh is not None:
+            d.scheduler.solver.set_mesh(mesh)
+        for wl in workloads:
+            d.create_workload(wl)
+        d.run_until_settled(max_cycles=300)
+        assert (d.scheduler.solver.stats["full_cycles"]
+                + d.scheduler.solver.stats["classify_cycles"]) >= 1
+        results.append(_admitted_assignments(d))
+    unsharded, meshed = results
+    assert unsharded == meshed
+
+
+def test_preempt_parity_mesh1_vs_unsharded():
+    """A preemption wave decided through the 1-device mesh must evict
+    exactly the same targets as the unsharded device path."""
+    def one(mesh):
+        clock = FakeClock()
+        d = Driver(clock=clock, use_device_solver=True)
+        if mesh is not None:
+            d.scheduler.solver.set_mesh(mesh)
+        d.apply_resource_flavor(ResourceFlavor(name="default"))
+        pre = PreemptionPolicy(
+            reclaim_within_cohort=ReclaimWithinCohort.ANY,
+            within_cluster_queue=WithinClusterQueue.LOWER_PRIORITY)
+        for q in range(3):
+            d.apply_cluster_queue(ClusterQueue(
+                name=f"cq-{q}", cohort="co", preemption=pre,
+                resource_groups=[ResourceGroup(
+                    covered_resources=["cpu"],
+                    flavors=[FlavorQuotas(name="default", resources={
+                        "cpu": ResourceQuota(nominal=2000,
+                                             borrowing_limit=4000)})])]))
+            d.apply_local_queue(LocalQueue(name=f"lq-{q}",
+                                           cluster_queue=f"cq-{q}"))
+        n = 0
+        for q in range(3):
+            for i in range(3):
+                n += 1
+                d.create_workload(Workload(
+                    name=f"lo-{q}-{i}", queue_name=f"lq-{q}", priority=1,
+                    creation_time=float(n),
+                    pod_sets=[PodSet(name="main", count=1,
+                                     requests={"cpu": 2000})]))
+        d.run_until_settled(max_cycles=100)
+        for q in range(3):
+            n += 1
+            d.create_workload(Workload(
+                name=f"hi-{q}", queue_name=f"lq-{q}", priority=100,
+                creation_time=float(n),
+                pod_sets=[PodSet(name="main", count=1,
+                                 requests={"cpu": 2000})]))
+        d.run_until_settled(max_cycles=100)
+        evicted = sorted(k for k, wl in d.workloads.items()
+                         if wl.is_evicted)
+        return _admitted_assignments(d), evicted, d
+
+    base_adm, base_ev, _ = one(None)
+    mesh_adm, mesh_ev, dm = one(make_mesh(1))
+    assert base_adm == mesh_adm
+    assert base_ev == mesh_ev
+    assert base_ev, "scenario produced no preemption"
+    assert dm.scheduler.solver.stats["host_cycles"] == 0
+
+
+def test_fs_parity_mesh1_vs_unsharded():
+    """FS tournament cycles through a 1-device mesh (the fs_scan_fn
+    GSPMD route) vs the unmeshed device dispatch, per-cycle."""
+    from tests.test_fs_device import build as fs_build
+    from tests.test_fs_device import fs_cluster
+    from tests.test_fs_device import mk as fs_mk
+    from tests.test_fs_device import run_cycles as fs_run_cycles
+
+    wls = [fs_mk(f"w-{q}-{i}", f"lq-0-{q}", 1500, t=float(q * 10 + i))
+           for q in range(3) for i in range(6)]
+    spec = fs_cluster(weights=(1.0, 2.0, 0.5), nominal=2000,
+                      borrowing=8000)
+    ds, cs = fs_build(spec, use_device=True)
+    dm, cm = fs_build(spec, use_device=True)
+    dm.scheduler.solver.set_mesh(make_mesh(1))
+    for d in (ds, dm):
+        for wl in wls:
+            d.create_workload(wl)
+    serial = fs_run_cycles(ds, cs, 10, runtime=3)
+    mesh = fs_run_cycles(dm, cm, 10, runtime=3)
+    for k, (s, m) in enumerate(zip(serial, mesh)):
+        assert s.admitted == m.admitted, f"cycle {k}"
+        assert sorted(s.skipped) == sorted(m.skipped), f"cycle {k}"
+        assert sorted(s.inadmissible) == sorted(m.inadmissible), \
+            f"cycle {k}"
+    assert ds.admitted_keys() == dm.admitted_keys()
+    assert dm.scheduler.solver.stats["fs_full_cycles"] > 0
+    assert dm.scheduler.solver.stats["sharded_fs_dispatches"] >= 1
