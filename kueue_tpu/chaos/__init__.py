@@ -1,0 +1,16 @@
+"""Deterministic chaos harness: seeded fault injection for crash,
+divergence, device-loss, journal-corruption, and transport scenarios.
+
+See :mod:`kueue_tpu.chaos.injector` for the site catalogue and
+``scripts/chaos_soak.py`` for the CHAOS_r09 soak that drives it."""
+
+from .injector import (   # noqa: F401
+    ACTIVE,
+    ChaosInjector,
+    Fault,
+    InjectedCrash,
+    active,
+    clear,
+    from_env,
+    install,
+)

@@ -1,0 +1,165 @@
+"""Seeded deterministic fault injection for the admission stack.
+
+A :class:`ChaosInjector` owns a set of armed :class:`Fault`\\ s, each
+bound to a named *site* — a fixed point in the hot path that consults
+the injector when it executes.  Sites fire deterministically: either at
+an exact hit index (``at=``) or by a seeded coin flip (``prob=``), so a
+scenario replays identically under the same seed.  The whole layer is
+inert unless an injector is installed: every site guards on the module
+global ``ACTIVE`` and costs one attribute read when chaos is off.
+
+Injection sites threaded through the stack:
+
+==============================  =============================================
+site                            effect at the call point
+==============================  =============================================
+``cycle.start``                 crash before a normal scheduling cycle
+``burst.window_boundary``       crash between fused-burst windows
+``burst.mid_window``            crash between applied cycles inside a window
+``burst.force_spec_divergence`` discard a speculative window unconsumed
+                                (forces the pipeline cancel path)
+``wal.admit``                   crash after the admit op is journaled but
+                                before the store write
+``wal.evict``                   crash after the evict op is journaled but
+                                before the status mutations
+``wal.finish``                  crash after the finish op is journaled but
+                                before the conditions flip
+``shard.device_loss``           drop ``payload`` devices from the burst mesh
+                                (re-partition over the survivors)
+``journal.drop_touch``          eat a PackJournal ``touch`` (lost update;
+                                the journal taints itself and the next pack
+                                falls back to a full walk)
+``journal.spurious_dirty_all``  raise the PackJournal dirty-all flag
+``remote.delay``                sleep ``payload`` seconds before a remote call
+``remote.duplicate``            issue a remote mutation twice
+``remote.partition``            fail the next ``times`` remote calls with
+                                ConnectionLost (healed by backoff retry)
+==============================  =============================================
+
+``KUEUE_TPU_CHAOS_SEED`` seeds the process-default injector (see
+:func:`from_env`); tests and the soak install one programmatically.
+"""
+
+from __future__ import annotations
+
+import os
+import random
+from dataclasses import dataclass, field
+from typing import Optional
+
+
+class InjectedCrash(RuntimeError):
+    """A chaos-armed crash site fired: the driver process 'dies' here.
+
+    Carries the site name; harnesses catch it, discard the driver, and
+    recover a fresh one from the durable store + WAL."""
+
+    def __init__(self, site: str):
+        super().__init__(f"injected crash at {site}")
+        self.site = site
+
+
+@dataclass
+class Fault:
+    """One armed fault: fires at hit ``at`` (1-based) or with seeded
+    probability ``prob``, up to ``times`` times in total."""
+    site: str
+    at: Optional[int] = None       # exact hit index (1-based)
+    prob: float = 0.0              # seeded per-hit coin flip
+    times: int = 1                 # max fires
+    action: str = "crash"          # "crash" | site-specific verb
+    payload: object = None         # site-specific argument
+    fired: int = 0                 # fires so far
+
+    def should_fire(self, hit: int, rng: random.Random) -> bool:
+        if self.fired >= self.times:
+            return False
+        if self.at is not None:
+            return hit == self.at or (self.times > 1 and hit > self.at)
+        return self.prob > 0 and rng.random() < self.prob
+
+
+class ChaosInjector:
+    """Deterministic, seeded fault injector.
+
+    ``hit(site)`` is called from an injection point; it counts the hit
+    and returns the armed :class:`Fault` that fires there (or None).
+    ``crashpoint(site)`` additionally raises :class:`InjectedCrash`
+    when the fired fault's action is ``"crash"``."""
+
+    def __init__(self, seed: int = 0):
+        self.seed = int(seed)
+        self.rng = random.Random(self.seed)
+        self.faults: list[Fault] = []
+        self.counts: dict[str, int] = {}
+        self.log: list[tuple[str, int, str]] = []  # (site, hit, action)
+
+    def arm(self, site: str, at: Optional[int] = None, prob: float = 0.0,
+            times: int = 1, action: str = "crash",
+            payload: object = None) -> Fault:
+        f = Fault(site=site, at=at, prob=prob, times=times,
+                  action=action, payload=payload)
+        self.faults.append(f)
+        return f
+
+    def disarm(self, site: str) -> None:
+        self.faults = [f for f in self.faults if f.site != site]
+
+    def hit(self, site: str) -> Optional[Fault]:
+        n = self.counts.get(site, 0) + 1
+        self.counts[site] = n
+        for f in self.faults:
+            if f.site == site and f.should_fire(n, self.rng):
+                f.fired += 1
+                self.log.append((site, n, f.action))
+                return f
+        return None
+
+    def crashpoint(self, site: str) -> None:
+        f = self.hit(site)
+        if f is not None and f.action == "crash":
+            raise InjectedCrash(site)
+
+    def report(self) -> dict:
+        """The ``chaos`` block stamped into artifacts: what was armed,
+        what actually fired, under which seed."""
+        return {
+            "seed": self.seed,
+            "hits": dict(sorted(self.counts.items())),
+            "armed": [{"site": f.site, "at": f.at, "prob": f.prob,
+                       "times": f.times, "action": f.action,
+                       "fired": f.fired} for f in self.faults],
+            "fired": [{"site": s, "hit": h, "action": a}
+                      for s, h, a in self.log],
+        }
+
+
+# The process-wide injector every site consults.  None = chaos off; the
+# per-site cost is then a module-global read and a None check.
+ACTIVE: Optional[ChaosInjector] = None
+
+
+def install(inj: Optional[ChaosInjector]) -> Optional[ChaosInjector]:
+    global ACTIVE
+    ACTIVE = inj
+    return inj
+
+
+def clear() -> None:
+    install(None)
+
+
+def active() -> Optional[ChaosInjector]:
+    return ACTIVE
+
+
+def from_env() -> Optional[ChaosInjector]:
+    """Install an injector seeded from ``KUEUE_TPU_CHAOS_SEED`` (unset
+    or empty = chaos off).  The caller arms faults afterwards."""
+    seed = os.environ.get("KUEUE_TPU_CHAOS_SEED", "")
+    if not seed:
+        return None
+    try:
+        return install(ChaosInjector(seed=int(seed)))
+    except ValueError:
+        return None

@@ -35,7 +35,9 @@ from ..api.types import (
     WL_QUOTA_RESERVED,
 )
 from ..cache.cache import Cache
+from ..chaos import injector as _chaos
 from ..queue.manager import Manager as QueueManager
+from ..utils import journal as _journal
 from ..queue.cluster_queue import RequeueReason
 from ..scheduler.scheduler import Scheduler
 from .. import webhooks
@@ -125,6 +127,11 @@ class Driver:
         self._burst_solver = None   # lazy BurstSolver (ops/burst.py)
         self._burst_m = 0           # sticky M bucket across burst packs
         self._burst_pack_state = None  # persistent delta-pack records
+        self._wal = None            # write-ahead cycle journal (CycleWAL)
+        # CQs whose interrupted-cycle decision was recovered from the
+        # WAL tail: they sit out the first post-recovery cycle so the
+        # completed cycle matches the uncrashed one decision-for-decision
+        self._resume_mask: set[str] = set()
 
     @staticmethod
     def _env_shards() -> int:
@@ -298,6 +305,41 @@ class Driver:
         else:
             self.queues.add_or_update_workload(wl)
 
+    def attach_wal(self, wal) -> None:
+        """Attach a write-ahead cycle journal (utils.journal.CycleWAL):
+        every admit/evict/requeue/finish decision is journaled before
+        the store mutation it describes, and each cycle's batch is
+        committed at the cycle boundary."""
+        self._wal = wal
+
+    def recover_from(self, stored, wal=None) -> int:
+        """Crash recovery (SURVEY §5.4 + the WAL): roll the journal's
+        uncommitted tail forward over the surviving store — using the
+        journaled timestamps, so the replayed status is bit-identical
+        to the uncrashed apply — then rebuild cache and queues from the
+        rolled-forward store via ``restore_workload``.  ``stored`` is
+        the durable workload store of the crashed driver (any iterable
+        of Workload); returns the number of tail ops replayed.  The WAL
+        stays attached, with its recovered tail committed."""
+        store = {wl.key: wl for wl in stored}
+        n = 0
+        mask: set[str] = set()
+        if wal is not None:
+            # an admit in the tail means its CQ's head slot for the
+            # interrupted cycle was consumed before the crash — that CQ
+            # must sit out the cycle's re-run or it would admit its next
+            # head a cycle earlier than the uncrashed driver did
+            for op in wal.tail:
+                if op.get("op") == "admit":
+                    mask.add(op["admission"]["cluster_queue"])
+            n = wal.replay_tail(store)
+            wal.commit()   # the tail is now fully reflected in state
+        for wl in store.values():
+            self.restore_workload(wl)
+        self._wal = wal
+        self._resume_mask = mask
+        return n
+
     def delete_workload(self, key: str) -> None:
         wl = self.workloads.pop(key, None)
         if wl is None:
@@ -323,6 +365,14 @@ class Driver:
         seen: set[str] = set()
         any_done = False
         now = self.clock()
+        if self._wal is not None:
+            live = [k for k in keys
+                    if (w := self.workloads.get(k)) is not None
+                    and not w.is_finished]
+            if live:
+                self._wal.log(_journal.finish_op(live, message, now))
+        if _chaos.ACTIVE is not None:
+            _chaos.ACTIVE.crashpoint("wal.finish")
         for key in keys:
             wl = self.workloads.get(key)
             if wl is None or wl.is_finished:
@@ -345,6 +395,8 @@ class Driver:
             self.queues.queue_inadmissible_workloads(touched)
         if any_done:
             self.wake_gate_blocked()
+        if self._wal is not None:
+            self._wal.commit()
 
     def update_reclaimable_pods(self, key: str, counts: dict[str, int]) -> None:
         """reference workload.UpdateReclaimablePods (KEP 78): shrink the
@@ -380,6 +432,8 @@ class Driver:
         wl = self.workloads.get(key)
         if wl is None:
             return
+        if self._wal is not None:
+            self._wal.log(_journal.deactivate_op(key))
         wl.active = False
         now = self.clock()
         if wl.admission is not None:
@@ -435,6 +489,10 @@ class Driver:
         cur = self.workloads.get(new_wl.key)
         if cur is None or cur.is_finished or not cur.is_active:
             return False
+        if self._wal is not None:
+            self._wal.log(_journal.admit_op(new_wl))
+        if _chaos.ACTIVE is not None:
+            _chaos.ACTIVE.crashpoint("wal.admit")
         self.workloads[new_wl.key] = new_wl
         self.queues.delete_workload(new_wl)
         cq = new_wl.admission.cluster_queue
@@ -460,6 +518,11 @@ class Driver:
                                 set_pods_ready_condition,
                                 set_preempted_condition)
         now = self.clock()
+        if self._wal is not None:
+            self._wal.log(_journal.evict_op(wl.key, reason, message,
+                                            preempted_reason, now))
+        if _chaos.ACTIVE is not None:
+            _chaos.ACTIVE.crashpoint("wal.evict")
         cq_name = wl.admission.cluster_queue if wl.admission else ""
         set_evicted_condition(wl, reason, message, now)
         # eviction stops the pods: a stale PodsReady=True must not exempt
@@ -557,6 +620,12 @@ class Driver:
         now = self.clock()
         update_requeue_state(wl, cfg.requeuing_backoff_base_seconds,
                              cfg.requeuing_backoff_max_seconds, now)
+        if self._wal is not None:
+            # logged post-mutation: the backoff math is deterministic and
+            # no crash site sits between this update and the eviction
+            # below, so replay's count guard keeps it exactly-once
+            self._wal.log(_journal.requeue_op(
+                key, wl.requeue_state.count, wl.requeue_state.requeue_at))
         limit = cfg.requeuing_backoff_limit_count
         if limit is not None and wl.requeue_state.count > limit:
             self.deactivate_workload(key)
@@ -649,11 +718,32 @@ class Driver:
     # ------------------------------------------------------------------
 
     def schedule_once(self):
+        if _chaos.ACTIVE is not None:
+            _chaos.ACTIVE.crashpoint("cycle.start")
         if self.wait_for_pods_ready.enable:
             self.enforce_wait_for_pods_ready()
         self.queues.wake_expired_backoffs()
-        stats = self.scheduler.schedule()
+        if self._resume_mask:
+            # complete the WAL-recovered interrupted cycle: CQs whose
+            # decision already replayed are held back (their popped
+            # heads go straight back into the queues), so this cycle's
+            # decisions land exactly where the uncrashed run put them
+            mask, self._resume_mask = self._resume_mask, set()
+            kept = []
+            for info in self.queues.heads_nonblocking():
+                wl = info.obj
+                lq = self.queues.local_queues.get(
+                    f"{wl.namespace}/{wl.queue_name}")
+                if lq is not None and lq.cluster_queue in mask:
+                    self.queues.add_or_update_workload(wl)
+                else:
+                    kept.append(info)
+            stats = self.scheduler.schedule(heads=kept)
+        else:
+            stats = self.scheduler.schedule()
         self.metrics.admission_attempt(bool(stats.admitted), stats.duration_s)
+        if self._wal is not None:
+            self._wal.commit()
         return stats
 
     def schedule_burst(self, max_cycles: int, runtime: int = 0,
@@ -752,6 +842,8 @@ class Driver:
             if batch:
                 self.finish_workloads(batch)
             stats.finish_s = _time.perf_counter() - t0
+            if self._wal is not None:
+                self._wal.commit()
             if on_cycle is not None:
                 on_cycle(k, stats)
 
@@ -807,7 +899,22 @@ class Driver:
             return None
 
         while len(out) < max_cycles:
-            if burst_ineligible or solver is None or normal_streak > 0:
+            if _chaos.ACTIVE is not None:
+                _chaos.ACTIVE.crashpoint("burst.window_boundary")
+                if (spec is not None and _chaos.ACTIVE.hit(
+                        "burst.force_spec_divergence") is not None):
+                    # chaos forces the pipeline cancel path: the
+                    # speculative window is discarded unconsumed and the
+                    # serial pack decides — bit-identical by the same
+                    # argument as every organic cancel
+                    bstats["burst_chaos_divergences"] = (
+                        bstats.get("burst_chaos_divergences", 0) + 1)
+                    spec = cancel_spec(spec)
+            if (burst_ineligible or solver is None or normal_streak > 0
+                    or self._resume_mask):
+                # a pending resume mask routes the first post-recovery
+                # cycle through schedule_once, which completes the
+                # WAL-interrupted cycle before bursting resumes
                 spec = cancel_spec(spec)
                 if normal_streak > 0 and not burst_ineligible:
                     bstats["burst_suppressed_cycles"] += 1
@@ -1014,6 +1121,8 @@ class Driver:
                 applied += 1
                 normal_streak = 0
                 dirty_backoff = 0
+                if _chaos.ACTIVE is not None:
+                    _chaos.ACTIVE.crashpoint("burst.mid_window")
             else:
                 window_complete = True
             if spec is not None and not window_complete:

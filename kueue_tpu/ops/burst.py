@@ -67,6 +67,7 @@ import numpy as np
 
 from .quota_kernel import available_all, available_at
 from .cycle import add_usage_chain_batched
+from ..chaos import injector as _chaos
 
 INF_I32 = np.int32(2**31 - 1)
 I32_MAX = 2**31 - 1
@@ -1811,7 +1812,14 @@ class BurstSolver:
                       # per-CQ row records; full repack on any miss)
                       "burst_delta_packs": 0, "burst_full_packs": 0,
                       "rows_reused": 0, "rows_repacked": 0,
-                      "delta_pack_s": 0.0}
+                      "delta_pack_s": 0.0,
+                      # graceful degradation (chaos shard.device_loss or
+                      # lose_devices): mesh rebuilt over the survivors,
+                      # serial fallback when fewer than two remain
+                      "burst_shard_degradations": 0,
+                      "burst_shard_serial_fallbacks": 0,
+                      # speculative windows discarded by injected faults
+                      "burst_chaos_divergences": 0}
         # mesh-sharded dispatch (forest partition over a 1-D "cq" axis;
         # parallel.sharded.BurstShardLayout) — off until set_shards(n>1)
         self.n_shards = 1
@@ -1840,6 +1848,31 @@ class BurstSolver:
             # become ready at fetch
             self.stats["burst_shard_pack_s"] = [0.0] * self.n_shards
             self.stats["burst_shard_fetch_s"] = [0.0] * self.n_shards
+
+    def lose_devices(self, n_lost: int = 1) -> int:
+        """Graceful shard degradation: ``n_lost`` devices of the burst
+        mesh died.  The mesh is rebuilt over the survivors and the next
+        ``_layout_for`` re-partitions the cohort forests across them
+        (value-remapped exactly like the original layout, so decisions
+        stay bit-identical); with fewer than two survivors the window
+        re-runs on the serial single-device path.  Returns the new
+        shard count."""
+        if self.n_shards <= 1:
+            return self.n_shards
+        from ..parallel.sharded import make_burst_mesh
+        survivors = max(1, self.n_shards - max(1, int(n_lost)))
+        mesh = make_burst_mesh(survivors) if survivors > 1 else None
+        self.n_shards = mesh.devices.size if mesh is not None else 1
+        self._shard_mesh = mesh
+        self._shard_layouts = {}
+        self._sharded_fns = {}
+        self.stats["burst_shard_degradations"] += 1
+        if mesh is None:
+            self.stats["burst_shard_serial_fallbacks"] += 1
+        else:
+            self.stats["burst_shard_pack_s"] = [0.0] * self.n_shards
+            self.stats["burst_shard_fetch_s"] = [0.0] * self.n_shards
+        return self.n_shards
 
     def _layout_for(self, plan: BurstPlan):
         from ..parallel.sharded import BurstShardLayout
@@ -1878,6 +1911,14 @@ class BurstSolver:
         ``permuted`` marks a chained state already in shard layout."""
         import jax
         import time as _time
+        if (_chaos.ACTIVE is not None and self.n_shards > 1
+                and not speculative and not permuted):
+            # device loss lands at fresh packs only: a chained carry is
+            # laid out for the old mesh and dispatch_next already
+            # refuses to cross dispatch modes
+            f = _chaos.ACTIVE.hit("shard.device_loss")
+            if f is not None:
+                self.lose_devices(int(f.payload or 1))
         if self.n_shards > 1 and self._shard_mesh is not None:
             return self._launch_sharded(plan, K, runtime, ext_release,
                                         ext_unpark, state, seq_base,

@@ -277,6 +277,30 @@ def burst_boundary_report(bstats: dict) -> dict:
     }
 
 
+def chaos_report(injector=None, bstats: dict | None = None,
+                 wal=None) -> dict:
+    """The ``chaos`` block stamped into artifacts: which faults were
+    armed and fired (seed included, so the scenario replays), what the
+    solver's degradation counters recorded, and how much of the WAL a
+    recovery had to roll forward."""
+    out: dict = {}
+    if injector is not None:
+        out.update(injector.report())
+    if bstats is not None:
+        out["degradations"] = {
+            "shard_degradations": bstats.get("burst_shard_degradations", 0),
+            "shard_serial_fallbacks": bstats.get(
+                "burst_shard_serial_fallbacks", 0),
+            "chaos_divergences": bstats.get("burst_chaos_divergences", 0),
+            "spec_cancelled": bstats.get("burst_spec_cancelled", 0),
+        }
+    if wal is not None:
+        out["wal"] = {"batches": len(wal.batches),
+                      "tail_ops": len(wal.tail),
+                      "path": wal.path}
+    return out
+
+
 def check_rangespec(stats: PerfStats, rangespec: dict) -> list[str]:
     """reference test/performance/scheduler checker semantics."""
     failures = []

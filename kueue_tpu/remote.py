@@ -30,6 +30,7 @@ from typing import Optional
 
 from .api import manifests as m
 from .api.types import Workload
+from .chaos import injector as _chaos
 
 
 class ConnectionLost(Exception):
@@ -67,6 +68,9 @@ class LocalWorkerClient:
         return {k: wl.is_finished
                 for k, wl in list(self.driver.workloads.items())}
 
+    def finish_workload(self, key: str, message: str = "finished") -> None:
+        self.driver.finish_workload(key, message)
+
     def watch_events(self, since: int, timeout: float = 0.0):
         """In-process watch: read the driver's append-only event log
         from the resume token (no blocking — the caller polls)."""
@@ -75,6 +79,103 @@ class LocalWorkerClient:
         events = self.driver.events
         batch = [tuple(e) for e in events[since:]]
         return batch, since + len(batch), str(id(self.driver))
+
+
+class ChaosWorkerClient:
+    """Transport fault injection for MultiKueue sync (chaos sites
+    ``remote.delay`` / ``remote.duplicate`` / ``remote.partition``),
+    wrapping any worker client with the same surface.
+
+    Faults model the reference's unreliable kubeconfig transport:
+
+    - *delay*: the call sleeps ``payload`` seconds first (a slow link);
+    - *duplicate*: a mutation is issued twice (an at-least-once retry
+      crossing a success) — workers absorb replays because ``create``
+      is keyed and ``delete``/``finish`` are idempotent;
+    - *partition*: the next ``times`` calls raise ConnectionLost; this
+      wrapper heals them with capped exponential-backoff retry
+      (multikueuecluster.go:67 retryAfter), so a partition shorter than
+      the retry budget is invisible to the controller and a longer one
+      surfaces as the usual mark-lost flow.
+    """
+
+    #: remote methods that mutate worker state (duplication targets)
+    _MUTATORS = ("create_workload", "delete_workload", "finish_workload")
+
+    def __init__(self, inner, injector=None, max_retries: int = 5,
+                 backoff_base: float = 0.01, backoff_max: float = 0.5):
+        self.inner = inner
+        self.injector = injector
+        self.max_retries = max_retries
+        self.backoff_base = backoff_base
+        self.backoff_max = backoff_max
+        self.stats = {"calls": 0, "delays": 0, "duplicates": 0,
+                      "partitioned": 0, "retries": 0}
+
+    def _inj(self):
+        return self.injector if self.injector is not None else _chaos.ACTIVE
+
+    def _call(self, name: str, *args, **kw):
+        import time as _time
+        inner_fn = getattr(self.inner, name)
+        inj = self._inj()
+        self.stats["calls"] += 1
+        if inj is None:
+            return inner_fn(*args, **kw)
+        backoff = self.backoff_base
+        last_err = None
+        for _ in range(self.max_retries + 1):
+            if inj.hit("remote.partition") is not None:
+                self.stats["partitioned"] += 1
+                self.stats["retries"] += 1
+                last_err = ConnectionLost(f"{name}: injected partition")
+                _time.sleep(backoff)
+                backoff = min(backoff * 2.0, self.backoff_max)
+                continue
+            f = inj.hit("remote.delay")
+            if f is not None:
+                self.stats["delays"] += 1
+                _time.sleep(float(f.payload or 0.01))
+            out = inner_fn(*args, **kw)
+            if (name in self._MUTATORS
+                    and inj.hit("remote.duplicate") is not None):
+                self.stats["duplicates"] += 1
+                inner_fn(*args, **kw)
+            return out
+        raise last_err or ConnectionLost(f"{name}: retries exhausted")
+
+    def healthy(self) -> bool:
+        try:
+            return bool(self._call("healthy"))
+        except ConnectionLost:
+            return False
+
+    def create_workload(self, wl: Workload) -> None:
+        self._call("create_workload", wl)
+
+    def get_workload(self, key: str) -> Optional[Workload]:
+        return self._call("get_workload", key)
+
+    def delete_workload(self, key: str) -> None:
+        self._call("delete_workload", key)
+
+    def list_workload_keys(self) -> list[str]:
+        return self._call("list_workload_keys")
+
+    def list_workloads(self) -> dict[str, bool]:
+        return self._call("list_workloads")
+
+    def finish_workload(self, key: str, message: str = "finished") -> None:
+        self._call("finish_workload", key, message)
+
+    def watch_events(self, since: int, timeout: float = 0.0):
+        # no retry loop here: the WatchLoop owns watch backoff and its
+        # lost/reconnected markers must see the raw failure
+        inj = self._inj()
+        if inj is not None and inj.hit("remote.partition") is not None:
+            self.stats["partitioned"] += 1
+            raise ConnectionLost("watch: injected partition")
+        return self.inner.watch_events(since, timeout=timeout)
 
 
 class WatchLoop:

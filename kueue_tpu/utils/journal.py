@@ -1,10 +1,11 @@
-"""Between-window mutation journal feeding the incremental burst pack.
+"""Journals: the between-window pack journal and the write-ahead cycle log.
 
-The queue manager and the cache each own one journal; their mutators
-mark the ClusterQueues whose packed rows may have changed.  The burst
-pack (ops/burst.py pack_burst_cached) drains both journals at every
-window boundary and re-walks only the dirty CQs, reusing the persistent
-per-CQ row records for everything else.
+``PackJournal`` is the in-memory mutation journal feeding the
+incremental burst pack.  The queue manager and the cache each own one;
+their mutators mark the ClusterQueues whose packed rows may have
+changed.  The burst pack (ops/burst.py pack_burst_cached) drains both
+journals at every window boundary and re-walks only the dirty CQs,
+reusing the persistent per-CQ row records for everything else.
 
 Two dirt grades keep the hot path clean:
 
@@ -19,20 +20,53 @@ Two dirt grades keep the hot path clean:
 ``touch_all`` covers global inputs the journal doesn't model per-CQ
 (e.g. LimitRange summaries).  A fresh journal starts dirty-all so the
 first pack is always a full walk.
+
+``CycleWAL`` is the durable sibling: a write-ahead log of the driver's
+per-cycle decision batches (admits, evictions, requeue-state updates,
+finishes).  Every op is journaled *before* the store mutation it
+describes, and a commit mark closes each cycle's batch, so a crash at
+any point leaves at most one partially-applied batch — the uncommitted
+tail.  Recovery rolls the tail forward over the surviving workload
+store (``replay_tail``, idempotent, using the journaled timestamps so
+the replayed status is bit-identical), then ``Driver.restore_workload``
+rebuilds cache and queues from the rolled-forward store.
+
+The on-disk format is one JSON object per line::
+
+    {"wal": "op", "op": "admit", "key": ..., ...}
+    {"wal": "commit", "batch": 0, "n": 3}
+
+``CycleWAL(path=...)`` appends and flushes per line;
+``CycleWAL.load(path)`` rebuilds batches and tail from the file.
 """
 
 from __future__ import annotations
 
+import json
+from typing import Optional
+
+from ..chaos import injector as _chaos
+
 
 class PackJournal:
-    __slots__ = ("dirty", "dirty_all", "soft")
+    __slots__ = ("dirty", "dirty_all", "soft", "tainted")
 
     def __init__(self):
         self.dirty: set[str] = set()
         self.soft: dict[str, set[str]] = {}
         self.dirty_all = True
+        # chaos: a simulated lost update (journal.drop_touch) taints the
+        # journal; the next drain reports dirty-all so the pack falls
+        # back to a full walk instead of trusting incomplete dirt
+        self.tainted = False
 
     def touch(self, cq_name: str) -> None:
+        if _chaos.ACTIVE is not None:
+            if _chaos.ACTIVE.hit("journal.drop_touch") is not None:
+                self.tainted = True
+                return
+            if _chaos.ACTIVE.hit("journal.spurious_dirty_all") is not None:
+                self.dirty_all = True
         self.dirty.add(cq_name)
 
     def touch_all(self) -> None:
@@ -46,16 +80,259 @@ class PackJournal:
 
     def drain_into(self, dirty: set, soft: dict) -> bool:
         """Merge this journal's content into the caller's accumulators
-        and reset it; returns the dirty-all flag that was set."""
-        was_all = self.dirty_all
+        and reset it; returns the dirty-all flag that was set.  Soft
+        roundtrip keys for CQs in the hard dirty set are dropped — those
+        CQs are re-walked anyway, so their keys would only bloat the
+        O(1) verify set."""
+        was_all = self.dirty_all or self.tainted
         dirty |= self.dirty
         for name, keys in self.soft.items():
+            if name in dirty:
+                continue
             acc = soft.get(name)
             if acc is None:
                 soft[name] = set(keys)
             else:
                 acc |= keys
+        for name in dirty:
+            soft.pop(name, None)
         self.dirty.clear()
         self.soft.clear()
         self.dirty_all = False
+        self.tainted = False
         return was_all
+
+
+# ---------------------------------------------------------------------------
+# Write-ahead cycle journal
+# ---------------------------------------------------------------------------
+
+class CycleWAL:
+    """Write-ahead journal of admission-cycle decision batches.
+
+    ``log(op)`` opens a batch implicitly; ``commit()`` closes it.  The
+    driver logs each op just before applying it to the store, and
+    commits at cycle boundaries, so the uncommitted ``tail`` is exactly
+    the set of decisions a crash may have half-applied."""
+
+    def __init__(self, path: Optional[str] = None):
+        self.path = path
+        self._fh = open(path, "a", encoding="utf-8") if path else None
+        self.batches: list[list[dict]] = []   # committed batches
+        self._open: Optional[list[dict]] = None
+
+    # -- writing --
+
+    def log(self, op: dict) -> None:
+        if self._open is None:
+            self._open = []
+        self._open.append(op)
+        self._emit(dict(op, wal="op"))
+
+    def commit(self) -> None:
+        if self._open is None:
+            return
+        self._emit({"wal": "commit", "batch": len(self.batches),
+                    "n": len(self._open)})
+        self.batches.append(self._open)
+        self._open = None
+
+    def _emit(self, rec: dict) -> None:
+        if self._fh is None:
+            return
+        self._fh.write(json.dumps(rec, sort_keys=True) + "\n")
+        self._fh.flush()
+
+    def close(self) -> None:
+        if self._fh is not None:
+            self._fh.close()
+            self._fh = None
+
+    # -- reading --
+
+    @property
+    def tail(self) -> list[dict]:
+        """Ops journaled since the last commit (possibly half-applied)."""
+        return list(self._open or ())
+
+    @classmethod
+    def load(cls, path: str) -> "CycleWAL":
+        """Rebuild a WAL from its JSON-lines file (the recovery read
+        path).  The returned WAL is read-only-ish: it has no file handle
+        so replay tooling can't accidentally extend the original log."""
+        wal = cls()
+        wal.path = path
+        with open(path, encoding="utf-8") as fh:
+            for line in fh:
+                line = line.strip()
+                if not line:
+                    continue
+                rec = json.loads(line)
+                if rec.get("wal") == "commit":
+                    wal.batches.append(wal._open or [])
+                    wal._open = None
+                else:
+                    rec.pop("wal", None)
+                    if wal._open is None:
+                        wal._open = []
+                    wal._open.append(rec)
+        return wal
+
+    # -- replay --
+
+    def replay_tail(self, store: dict) -> int:
+        """Roll the uncommitted tail forward over ``store`` (a
+        ``{key: Workload}`` dict).  Idempotent: ops whose effect is
+        already visible are skipped, so replay after a crash anywhere
+        between journal write and store write converges to the same
+        state as the uncrashed apply.  Returns the op count replayed."""
+        n = 0
+        for op in self.tail:
+            if replay_op(store, op):
+                n += 1
+        return n
+
+
+# -- op encode/decode -------------------------------------------------------
+
+def _encode_condition(c) -> dict:
+    return {"type": c.type, "status": c.status.value, "reason": c.reason,
+            "message": c.message, "ltt": c.last_transition_time,
+            "gen": c.observed_generation}
+
+
+def _encode_admission(adm) -> dict:
+    return {"cluster_queue": adm.cluster_queue,
+            "psa": [{"name": a.name, "flavors": dict(a.flavors),
+                     "usage": dict(a.resource_usage), "count": a.count}
+                    for a in adm.pod_set_assignments]}
+
+
+def admit_op(wl) -> dict:
+    """The SSA-shaped admit record: the workload's full post-decision
+    status (admission, conditions, check states, requeue state).  Pure
+    data — replay replaces the stored status wholesale, which makes the
+    op trivially idempotent."""
+    return {
+        "op": "admit",
+        "key": wl.key,
+        "admission": _encode_admission(wl.admission),
+        "conditions": [_encode_condition(c)
+                       for c in wl.conditions.values()],
+        "checks": [{"name": s.name, "state": s.state.value,
+                    "message": s.message, "ltt": s.last_transition_time}
+                   for s in wl.admission_check_states.values()],
+        "requeue": (None if wl.requeue_state is None else
+                    {"count": wl.requeue_state.count,
+                     "at": wl.requeue_state.requeue_at}),
+    }
+
+
+def evict_op(key: str, reason: str, message: str,
+             preempted_reason: Optional[str], now: float) -> dict:
+    return {"op": "evict", "key": key, "reason": reason,
+            "message": message, "pre": preempted_reason, "now": now}
+
+
+def requeue_op(key: str, count: int, requeue_at: Optional[float]) -> dict:
+    return {"op": "requeue", "key": key, "count": count, "at": requeue_at}
+
+
+def finish_op(keys: list[str], message: str, now: float) -> dict:
+    return {"op": "finish", "keys": list(keys), "message": message,
+            "now": now}
+
+
+def deactivate_op(key: str) -> dict:
+    return {"op": "deactivate", "key": key}
+
+
+def replay_op(store: dict, op: dict) -> bool:
+    """Apply one journaled op to the plain workload store.  Pure status
+    mutation — no cache or queue side effects; ``restore_workload``
+    rebuilds those from the rolled-forward store afterwards.  Returns
+    False when the op was already applied (or its workload is gone)."""
+    from ..api.types import (Admission, AdmissionCheckState,
+                             AdmissionCheckStatus, Condition,
+                             ConditionStatus, PodSetAssignment,
+                             RequeueState, WL_EVICTED)
+    from ..workload import (set_evicted_condition, set_finished_condition,
+                            set_pods_ready_condition,
+                            set_preempted_condition, set_requeued_condition,
+                            unset_quota_reservation)
+    kind = op.get("op")
+    if kind == "finish":
+        any_done = False
+        for key in op["keys"]:
+            wl = store.get(key)
+            if wl is None or wl.is_finished:
+                continue
+            set_finished_condition(wl, "JobFinished", op["message"],
+                                   op["now"])
+            any_done = True
+        return any_done
+    wl = store.get(op.get("key", ""))
+    if wl is None:
+        return False
+    if kind == "admit":
+        if wl.is_finished:
+            return False
+        enc = op["admission"]
+        wl.admission = Admission(
+            cluster_queue=enc["cluster_queue"],
+            pod_set_assignments=[
+                PodSetAssignment(name=a["name"], flavors=dict(a["flavors"]),
+                                 resource_usage=dict(a["usage"]),
+                                 count=a["count"])
+                for a in enc["psa"]])
+        wl.conditions = {
+            c["type"]: Condition(type=c["type"],
+                                 status=ConditionStatus(c["status"]),
+                                 reason=c["reason"], message=c["message"],
+                                 last_transition_time=c["ltt"],
+                                 observed_generation=c["gen"])
+            for c in op["conditions"]}
+        wl.admission_check_states = {
+            s["name"]: AdmissionCheckStatus(
+                name=s["name"], state=AdmissionCheckState(s["state"]),
+                message=s["message"], last_transition_time=s["ltt"])
+            for s in op["checks"]}
+        rq = op.get("requeue")
+        wl.requeue_state = (None if rq is None else
+                            RequeueState(count=rq["count"],
+                                         requeue_at=rq["at"]))
+        return True
+    if kind == "evict":
+        ev = wl.conditions.get(WL_EVICTED)
+        if (ev is not None and ev.status == ConditionStatus.TRUE
+                and ev.reason == op["reason"]
+                and ev.last_transition_time == op["now"]):
+            return False   # the mutation landed before the crash
+        now = op["now"]
+        set_evicted_condition(wl, op["reason"], op["message"], now)
+        from ..api.types import WL_PODS_READY
+        if WL_PODS_READY in wl.conditions:
+            set_pods_ready_condition(wl, False, now)
+        if op.get("pre") is not None:
+            set_preempted_condition(wl, op["pre"], op["message"], now)
+        for st in wl.admission_check_states.values():
+            st.state = AdmissionCheckState.PENDING
+        if wl.admission is not None:
+            unset_quota_reservation(wl, op["reason"], op["message"], now)
+        set_requeued_condition(wl, op["reason"], op["message"], True, now)
+        return True
+    if kind == "requeue":
+        rs = wl.requeue_state
+        if rs is not None and rs.count >= op["count"]:
+            return False
+        if rs is None:
+            wl.requeue_state = RequeueState()
+        wl.requeue_state.count = op["count"]
+        wl.requeue_state.requeue_at = op["at"]
+        return True
+    if kind == "deactivate":
+        if not wl.active:
+            return False
+        wl.active = False
+        return True
+    return False

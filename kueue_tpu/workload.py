@@ -281,17 +281,38 @@ def set_finished_condition(wl: Workload, reason: str, message: str, now: float) 
                      message=message, now=now)
 
 
+def _jitter_fraction(key: str, count: int) -> float:
+    """Deterministic per-(workload, attempt) fraction in [0, 1] — stable
+    across processes (hash() is salted; crc32 is not) so journal replay
+    and A/B parity runs compute identical backoff deadlines."""
+    import zlib
+    return zlib.crc32(f"{key}/{count}".encode()) / 0xFFFFFFFF
+
+
 def update_requeue_state(wl: Workload, backoff_base_seconds: int,
                          backoff_max_seconds: int, now: float,
                          jitter: float = 0.0) -> None:
     """Exponential requeue backoff: base·2^(n−1) capped at max
-    (reference workload.go:514 UpdateRequeueState)."""
+    (reference workload.go:514 UpdateRequeueState).
+
+    The exponent is clamped before the power is taken: a workload
+    evicted thousands of times must not materialize a thousand-bit
+    integer just for ``min`` to discard it.  ``jitter`` > 0 stretches
+    each deadline by a per-workload fraction of up to that much, so a
+    cohort evicted en masse fans back in instead of requeuing in
+    lockstep — deterministic, so parity arms agree."""
     if wl.requeue_state is None:
         wl.requeue_state = RequeueState()
     count = wl.requeue_state.count + 1
-    wait_s = min(backoff_base_seconds * (2 ** (count - 1)),
-                 backoff_max_seconds)
-    wait_s += wait_s * jitter
+    if backoff_base_seconds <= 0:
+        wait_s = 0
+    elif count - 1 >= (backoff_max_seconds // backoff_base_seconds).bit_length():
+        wait_s = backoff_max_seconds
+    else:
+        wait_s = min(backoff_base_seconds * (2 ** (count - 1)),
+                     backoff_max_seconds)
+    if jitter:
+        wait_s += wait_s * jitter * _jitter_fraction(wl.key, count)
     wl.requeue_state.requeue_at = now + wait_s
     wl.requeue_state.count = count
 

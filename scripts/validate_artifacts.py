@@ -1,0 +1,157 @@
+"""Schema check for the repo's run artifacts (``*_r*.json``).
+
+The artifact files are the repo's durable experimental record; a
+truncated write, a hand edit, or a schema drift in a generator script
+should fail fast in CI instead of surfacing months later as an
+unreadable number.  Checks are tiered:
+
+  every artifact   — parses as JSON, top level is a non-empty object,
+                     and any of the common optional fields that ARE
+                     present have the right shape (``metric`` str,
+                     ``value`` number/null, ``unit`` str, ``cqs`` int,
+                     ``mesh`` a dict with int ``n_devices`` and str
+                     ``platform``).
+  CHAOS_*          — additionally: a non-empty ``scenarios`` object
+                     whose entries each carry ``decisions_stable``
+                     bool + list ``failures`` (or ``skipped`` true
+                     with a ``reason``), plus ``all_stable`` /
+                     ``scenarios_total`` / ``scenarios_stable``
+                     consistent with the per-scenario verdicts.
+  NORTHSTAR_* /
+  MULTICHIP_r08+   — additionally: ``metric`` + numeric ``value``.
+
+Usage:
+    python scripts/validate_artifacts.py [paths...]
+
+With no paths, scans the repo root for ``*_r*.json``.  Exits non-zero
+on any violation, listing every one.
+"""
+
+from __future__ import annotations
+
+import glob
+import json
+import os
+import sys
+
+
+def _err(out, path, msg):
+    out.append(f"{os.path.basename(path)}: {msg}")
+
+
+def _check_common(d, path, out):
+    if not isinstance(d, dict) or not d:
+        _err(out, path, "top level must be a non-empty JSON object")
+        return False
+    if "metric" in d and not isinstance(d["metric"], str):
+        _err(out, path, "'metric' must be a string")
+    if "value" in d and d["value"] is not None \
+            and not isinstance(d["value"], (int, float)):
+        _err(out, path, "'value' must be a number or null")
+    if "unit" in d and not isinstance(d["unit"], str):
+        _err(out, path, "'unit' must be a string")
+    if "cqs" in d and not isinstance(d["cqs"], int):
+        _err(out, path, "'cqs' must be an int")
+    mesh = d.get("mesh")
+    if mesh is not None:
+        if not isinstance(mesh, dict):
+            _err(out, path, "'mesh' must be an object")
+        else:
+            if not isinstance(mesh.get("n_devices"), int):
+                _err(out, path, "'mesh.n_devices' must be an int")
+            if not isinstance(mesh.get("platform"), str):
+                _err(out, path, "'mesh.platform' must be a string")
+    return True
+
+
+def _check_chaos(d, path, out):
+    scenarios = d.get("scenarios")
+    if not isinstance(scenarios, dict) or not scenarios:
+        _err(out, path, "'scenarios' must be a non-empty object")
+        return
+    n_ran = n_stable = 0
+    for name, s in scenarios.items():
+        if not isinstance(s, dict):
+            _err(out, path, f"scenario '{name}' must be an object")
+            continue
+        if s.get("skipped"):
+            if not isinstance(s.get("reason"), str):
+                _err(out, path, f"skipped scenario '{name}' needs a "
+                     "'reason' string")
+            continue
+        n_ran += 1
+        if not isinstance(s.get("decisions_stable"), bool):
+            _err(out, path, f"scenario '{name}' missing bool "
+                 "'decisions_stable'")
+            continue
+        if not isinstance(s.get("failures"), list):
+            _err(out, path, f"scenario '{name}' missing 'failures' list")
+        if s["decisions_stable"]:
+            n_stable += 1
+            if s.get("failures"):
+                _err(out, path, f"scenario '{name}' claims stable but "
+                     f"lists failures: {s['failures'][:2]}")
+    if not isinstance(d.get("all_stable"), bool):
+        _err(out, path, "missing bool 'all_stable'")
+    elif d["all_stable"] != (n_ran > 0 and n_stable == n_ran):
+        _err(out, path, f"'all_stable'={d['all_stable']} inconsistent "
+             f"with {n_stable}/{n_ran} stable scenarios")
+    if d.get("scenarios_total") != n_ran:
+        _err(out, path, f"'scenarios_total'={d.get('scenarios_total')} "
+             f"but {n_ran} scenarios ran")
+    if d.get("scenarios_stable") != n_stable:
+        _err(out, path, f"'scenarios_stable'={d.get('scenarios_stable')} "
+             f"but {n_stable} verdicts are stable")
+
+
+def _check_metric_value(d, path, out):
+    if not isinstance(d.get("metric"), str):
+        _err(out, path, "missing string 'metric'")
+    if not isinstance(d.get("value"), (int, float)):
+        _err(out, path, "missing numeric 'value'")
+
+
+# generator scripts that postdate the schema convention (metric+value
+# at top level); older BENCH_/MULTICHIP_r01-05 wrappers predate it and
+# only get the common checks
+_STRICT_PREFIXES = ("NORTHSTAR_", "CHAOS_")
+
+
+def validate(path: str) -> list[str]:
+    out: list[str] = []
+    try:
+        with open(path) as f:
+            d = json.load(f)
+    except (OSError, ValueError) as e:
+        return [f"{os.path.basename(path)}: unreadable ({e})"]
+    if not _check_common(d, path, out):
+        return out
+    base = os.path.basename(path).upper()
+    # by name or by shape: a scenarios table is a chaos artifact even
+    # if the file was renamed
+    if base.startswith("CHAOS_") or "scenarios" in d:
+        _check_chaos(d, path, out)
+    if base.startswith(_STRICT_PREFIXES) or base == "MULTICHIP_R08.JSON":
+        _check_metric_value(d, path, out)
+    return out
+
+
+def main() -> int:
+    root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    paths = sys.argv[1:] or sorted(glob.glob(os.path.join(root,
+                                                          "*_r*.json")))
+    if not paths:
+        print("validate_artifacts: no artifacts found", file=sys.stderr)
+        return 1
+    failures: list[str] = []
+    for p in paths:
+        failures.extend(validate(p))
+    for msg in failures:
+        print(f"FAIL {msg}", file=sys.stderr)
+    print(f"validate_artifacts: {len(paths)} artifact(s), "
+          f"{len(failures)} violation(s)")
+    return 1 if failures else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
