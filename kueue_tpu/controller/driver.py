@@ -891,11 +891,14 @@ class Driver:
         last_adm_clock = None
         clock_monotone = True
 
-        def cancel_spec(h):
+        def cancel_spec(h, why=""):
             """Discard an in-flight speculative window unfetched — its
             assumptions were invalidated; it must never be applied."""
             if h is not None:
                 bstats["burst_spec_cancelled"] += 1
+                if os.environ.get("KUEUE_BURST_DEBUG"):
+                    import sys as _sys
+                    print(f"spec cancel: {why}", file=_sys.stderr)
             return None
 
         while len(out) < max_cycles:
@@ -909,13 +912,13 @@ class Driver:
                     # argument as every organic cancel
                     bstats["burst_chaos_divergences"] = (
                         bstats.get("burst_chaos_divergences", 0) + 1)
-                    spec = cancel_spec(spec)
+                    spec = cancel_spec(spec, "chaos")
             if (burst_ineligible or solver is None or normal_streak > 0
                     or self._resume_mask):
                 # a pending resume mask routes the first post-recovery
                 # cycle through schedule_once, which completes the
                 # WAL-interrupted cycle before bursting resumes
-                spec = cancel_spec(spec)
+                spec = cancel_spec(spec, "ineligible/streak/resume")
                 if normal_streak > 0 and not burst_ineligible:
                     bstats["burst_suppressed_cycles"] += 1
                 normal_streak = max(0, normal_streak - 1)
@@ -928,7 +931,7 @@ class Driver:
                 # structure drifted: one snapshot rebuilds the cached
                 # tensors; steady-state re-packs skip the snapshot cost
                 st = solver._structure_for(self.cache.snapshot(), [])
-                spec = cancel_spec(spec)
+                spec = cancel_spec(spec, "structure-drift")
             remaining = max_cycles - len(out)
             if spec is not None:
                 # pipelined boundary: this window's pack+dispatch
@@ -985,8 +988,13 @@ class Driver:
                 # apply modeled preempt cycles if violated
                 last_adm_clock = plan.max_res_ts
                 clock_monotone = True
-            (head_row, kind, slot, borrows, tgt_words, dirty,
-             dirty_reason) = self._burst_solver.fetch(handle)
+            # flags-first fetch: block only on the tiny replicated dirty
+            # flags (the spec gate's whole input) and park the carry, so
+            # the chained next-window dispatch is issued BEFORE the full
+            # decision planes are assembled — each shard's decision
+            # transfer then overlaps the chained kernel and this
+            # window's apply loop instead of serializing ahead of them
+            dirty, dirty_reason = self._burst_solver.fetch_flags(handle)
             base = len(out)
             # two-slot pipeline: chain the NEXT window off this one's
             # final carry before applying, so its kernel computes while
@@ -1011,6 +1019,8 @@ class Driver:
                     handle,
                     np.zeros((K, plan.C, F), dtype=np.int32),
                     np.zeros((K, plan.G), dtype=bool))
+            (head_row, kind, slot, borrows, tgt_words, dirty,
+             dirty_reason) = self._burst_solver.fetch(handle)
             from ..ops import burst as _b
             kind_name = {_b.KIND_ADMIT: "admit", _b.KIND_SKIP: "skip",
                          _b.KIND_PARK: "park", _b.KIND_PREEMPT: "preempt",
@@ -1048,6 +1058,10 @@ class Driver:
                                     bool(borrows[k, ci]), targets)
                 if not dirty[k] and not modeled and quiescent():
                     drained = True
+                    if os.environ.get("KUEUE_BURST_DEBUG"):
+                        import sys as _sys
+                        print(f"win break @k={k}: drained",
+                              file=_sys.stderr)
                     break
                 # the cycle boundary in schedule_once order: advance the
                 # caller's clock FIRST, then fire deadline/backoff timers
@@ -1075,10 +1089,18 @@ class Driver:
                 if has_pre_kind and not clock_monotone:
                     # modeled candidate order may diverge from the host's
                     # reservation-timestamp order: decide on the host
+                    if os.environ.get("KUEUE_BURST_DEBUG"):
+                        import sys as _sys
+                        print(f"win break @k={k}: clock-monotone",
+                              file=_sys.stderr)
                     normal_cycle(heads=heads, advance=False)
                     break
                 if {h.key for h in heads} != set(modeled):
                     # unmodeled divergence: decide this cycle normally
+                    if os.environ.get("KUEUE_BURST_DEBUG"):
+                        import sys as _sys
+                        print(f"win break @k={k}: heads-mismatch",
+                              file=_sys.stderr)
                     normal_cycle(heads=heads, advance=False)
                     break
                 if not modeled:
@@ -1129,11 +1151,11 @@ class Driver:
                 # the window was truncated (dirty / divergence / clock):
                 # live state no longer matches the carry the speculative
                 # window chained from — it must never be applied
-                spec = cancel_spec(spec)
+                spec = cancel_spec(spec, "window-truncated")
             if drained:
-                spec = cancel_spec(spec)
+                spec = cancel_spec(spec, "drained")
                 break
-        spec = cancel_spec(spec)
+        spec = cancel_spec(spec, "end-of-call")
         return out
 
     def _fill_burst_finishes(self, st, plan, ext: dict, base: int, K: int,

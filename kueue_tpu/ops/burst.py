@@ -58,6 +58,10 @@ no sequential remove-chain walks.
 
 from __future__ import annotations
 
+import itertools
+import os
+import sys
+
 from dataclasses import dataclass
 from functools import partial
 
@@ -291,22 +295,17 @@ def _burst_cycles(
         dirty_c = has_head & ((has_preempt & ~pre_model)
                               | ~vec_ok[cidx, row] | resume[cidx, row])
         # dirty/dirty_reason are the kernel's ONLY cross-forest
-        # quantities (everything else is forest-local); under a sharded
-        # dispatch each device reduces its own forests and a psum —
-        # executed unconditionally every cycle, so all shards agree on
-        # collective trip counts — folds them into the global flags
+        # quantities (everything else is forest-local), and nothing in
+        # the scan's state transitions reads the GLOBAL flags (park_new
+        # gates on the forest-local dirty_c) — so each cycle emits its
+        # local reduction and the cross-shard psum is hoisted out of
+        # the scan: one collective per WINDOW instead of one per cycle,
+        # which removes K sync barriers from every sharded dispatch
         dflags = jnp.stack([
             jnp.any(dirty_c).astype(jnp.int32),
             jnp.any(has_preempt & ~pre_model).astype(jnp.int32),
             jnp.any(has_head & ~vec_ok[cidx, row]).astype(jnp.int32),
             jnp.any(has_head & resume[cidx, row]).astype(jnp.int32)])
-        if axis_name is not None:
-            dflags = jax.lax.psum(dflags, axis_name)
-        dirty = dflags[0] > 0
-        dirty_reason = (
-            (dflags[1] > 0).astype(jnp.int32) * DIRTY_PREEMPT
-            + (dflags[2] > 0).astype(jnp.int32) * DIRTY_SCALAR
-            + (dflags[3] > 0).astype(jnp.int32) * DIRTY_RESUME)
 
         # -- nominate-time preemption searches (preemption.go:127-342) -
         def run_searches(_):
@@ -711,7 +710,7 @@ def _burst_cycles(
             * bit_w[None, None, :], axis=-1)                   # [C,KCW]
 
         out = (jnp.where(has_head, row, -1), kind, slot_out,
-               borrows_out, tgt_words, dirty, dirty_reason)
+               borrows_out, tgt_words, dflags)
         carry = (elig, parked, resume, adm, adm_seq, adm_usage,
                  adm_uses, death, u_cq_next)
         return carry, out
@@ -720,7 +719,14 @@ def _burst_cycles(
               adm_uses0, death0, u_cq0)
     carry, outs = jax.lax.scan(cycle, carry0,
                                jnp.arange(K, dtype=jnp.int32))
-    head_row, kind, slot, borrows, tgt_words, dirty, dirty_reason = outs
+    head_row, kind, slot, borrows, tgt_words, dflags = outs
+    if axis_name is not None:
+        dflags = jax.lax.psum(dflags, axis_name)           # [K, 4]
+    dirty = dflags[:, 0] > 0
+    dirty_reason = (
+        (dflags[:, 1] > 0).astype(jnp.int32) * DIRTY_PREEMPT
+        + (dflags[:, 2] > 0).astype(jnp.int32) * DIRTY_SCALAR
+        + (dflags[:, 3] > 0).astype(jnp.int32) * DIRTY_RESUME)
     # the full final carry is returned so a pipelined caller can chain
     # the NEXT window's dispatch off the device-resident state (death
     # rebased by -K, seq_base advanced) without a host re-pack
@@ -852,6 +858,14 @@ class BurstPlan:
     seq_base: int = 1
     row_of_key: dict = None           # key -> (ci, mi)
     max_res_ts: Optional[float] = None  # newest pre-burst reservation
+    # shard-resident chaining (pack_burst_cached): the delta-pack state
+    # tokens this plan consumed/produced and the CQ indices it re-walked.
+    # A resident device copy of the PREVIOUS pack's rows is reusable iff
+    # its token matches prev_token — then exactly dirty_cqs rows differ.
+    pack_token: Optional[int] = None
+    prev_token: Optional[int] = None
+    dirty_cqs: Optional[np.ndarray] = None   # None = full walk
+    dirty_ranges: Optional[list] = None      # coalesced [lo, hi) rows
 
 
 def build_candidate_tables(forest_of_cq: np.ndarray, members: np.ndarray,
@@ -1565,13 +1579,19 @@ class DeltaPackState:
     window) key; ``pack_burst_cached`` re-walks only journaled-dirty
     CQs against it and re-fuses stage B from the mixed records.
     ``fields`` holds the flat stage-B row concatenation so the next
-    window splices only the dirty segments."""
-    __slots__ = ("key", "records", "fields")
+    window splices only the dirty segments.  ``token`` is a process-wide
+    monotone serial: plans record the tokens they consumed/produced so a
+    shard-resident device copy can prove it chains from the same state
+    (object identity is not enough — ids alias after GC)."""
+    __slots__ = ("key", "records", "fields", "token")
+
+    _next_token = itertools.count(1)
 
     def __init__(self, key, records, fields=None):
         self.key = key
         self.records = records
         self.fields = fields
+        self.token = next(DeltaPackState._next_token)
 
 
 def _roundtrips_clean(rec, q, cq_live, keys) -> bool:
@@ -1639,13 +1659,15 @@ def pack_burst_cached(structure, queues, cache, scheduler, clock,
     st = structure
     dirty: set = set()
     soft: dict = {}
+    jranges: list = []
     force_full = False
     for j in (getattr(queues, "pack_journal", None),
               getattr(cache, "pack_journal", None)):
         if j is None:
             force_full = True
         else:
-            force_full |= j.drain_into(dirty, soft)
+            force_full |= j.drain_into(dirty, soft, row_of=st.cq_index,
+                                       ranges_out=jranges)
     enabled = os.environ.get("KUEUE_BURST_DELTA_PACK", "1") != "0"
     key = (st.generation, st.resource_scale.tobytes(),
            tuple(st.cq_names), window)
@@ -1667,10 +1689,12 @@ def pack_burst_cached(structure, queues, cache, scheduler, clock,
             stats["rows_repacked"] = (
                 stats.get("rows_repacked", 0)
                 + sum(r.n_rows for r in records))
-        return (plan,
-                DeltaPackState(key, records, fields) if enabled
-                else None,
-                False)
+        new_state = (DeltaPackState(key, records, fields) if enabled
+                     else None)
+        # a full walk cannot chain a resident device copy (dirty set is
+        # unbounded) but it SEEDS one: the next delta pack may scatter
+        plan.pack_token = new_state.token if new_state else None
+        return plan, new_state, False
 
     if not enabled or state is None or state.key != key or force_full:
         return _full()
@@ -1732,6 +1756,18 @@ def pack_burst_cached(structure, queues, cache, scheduler, clock,
                           fields_out=fields)
     if plan is None:
         return None, None, False
+    new_state = DeltaPackState(key, records, fields)
+    # resident chaining facts: which state this plan consumed/produced
+    # and exactly which CQ rows differ from the consumed state's plan
+    # (post-escalation; clean rows were spliced verbatim, so a device
+    # copy of the previous rows needs only these scattered)
+    dirty_cis = sorted(index_of[name] for name in dirty
+                       if name in index_of)
+    plan.pack_token = new_state.token
+    plan.prev_token = state.token
+    plan.dirty_cqs = np.asarray(dirty_cis, dtype=np.int64)
+    from ..utils.journal import PackJournal
+    plan.dirty_ranges = PackJournal.coalesce(dirty_cis)
     if stats is not None:
         stats["burst_delta_packs"] = (
             stats.get("burst_delta_packs", 0) + 1)
@@ -1742,13 +1778,29 @@ def pack_burst_cached(structure, queues, cache, scheduler, clock,
             + sum(r.n_rows for r in records) - repacked)
         stats["delta_pack_s"] = (
             stats.get("delta_pack_s", 0.0) + time.perf_counter() - t0)
-    return plan, DeltaPackState(key, records, fields), True
+        stats["burst_journal_dirty_ranges"] = (
+            stats.get("burst_journal_dirty_ranges", 0) + len(jranges))
+    return plan, new_state, True
 
 
 # one K rung: every distinct K is a full kernel compilation, and a
 # 32-cycle window amortizes the dispatch while deciding a few unused
 # cycles at most ~15ms of kernel time when fewer remain
 K_BURST_LADDER = (32,)
+
+
+class _ResidentRows:
+    """Device-resident scatter-tier row planes from the last fresh
+    sharded dispatch, keyed by the DeltaPackState token that produced
+    them.  The next fresh pack reuses them when its ``prev_token``
+    matches: the delta pack spliced every clean record verbatim, so
+    only its ``dirty_cqs`` rows need to re-cross the host boundary."""
+    __slots__ = ("layout", "token", "planes")
+
+    def __init__(self, layout, token, planes):
+        self.layout = layout
+        self.token = token
+        self.planes = planes
 
 
 @dataclass
@@ -1768,6 +1820,7 @@ class BurstHandle:
     dev: object
     pending: object = None       # kernel output tuple, still async
     decisions: tuple = None      # fetched numpy decision arrays
+    flags: tuple = None          # (dirty, dirty_reason) via fetch_flags
     carry: tuple = None          # final scan state (jax arrays)
     speculative: bool = False
     t_dispatch: float = 0.0
@@ -1819,13 +1872,37 @@ class BurstSolver:
                       "burst_shard_degradations": 0,
                       "burst_shard_serial_fallbacks": 0,
                       # speculative windows discarded by injected faults
-                      "burst_chaos_divergences": 0}
+                      "burst_chaos_divergences": 0,
+                      # shard-resident boundary: fresh packs whose row
+                      # planes stayed on the mesh (only dirty rows
+                      # scattered from host) vs full re-uploads, and the
+                      # host→device bytes actually paid vs what the
+                      # upload-everything boundary would have paid
+                      "burst_resident_hits": 0,
+                      "burst_resident_misses": 0,
+                      "burst_resident_scatter_rows": 0,
+                      "burst_resident_scatter_ranges": 0,
+                      "burst_resident_scatter_s": 0.0,
+                      "burst_boundary_bytes_h2d": 0,
+                      "burst_boundary_bytes_equiv": 0,
+                      # coalesced dirty-row ranges seen by the journal
+                      "burst_journal_dirty_ranges": 0,
+                      # cost-balanced forest partition (EWMA of decided
+                      # heads per forest, fed to BurstShardLayout)
+                      "burst_layout_rebuilds": 0,
+                      "burst_layout_cost_balanced": 0,
+                      "burst_shard_cost_ratio": 0.0}
         # mesh-sharded dispatch (forest partition over a 1-D "cq" axis;
         # parallel.sharded.BurstShardLayout) — off until set_shards(n>1)
         self.n_shards = 1
         self._shard_mesh = None
         self._shard_layouts: dict = {}
         self._sharded_fns: dict = {}
+        # shard-resident device copy of the last fresh pack's row planes
+        # + the per-forest cycle-cost EWMA feeding the next layout
+        self._resident = None
+        self._scatter_jit = None
+        self._forest_cost: dict | None = None
 
     def set_shards(self, n: int):
         """Shard burst dispatches across ``n`` devices: cohort forests
@@ -1840,6 +1917,8 @@ class BurstSolver:
         self._shard_mesh = mesh
         self._shard_layouts = {}
         self._sharded_fns = {}
+        self._resident = None
+        self._scatter_jit = None
         if mesh is not None:
             self.stats.setdefault("burst_sharded_dispatches", 0)
             # per-shard timing vectors (list-valued stats): how long the
@@ -1866,6 +1945,10 @@ class BurstSolver:
         self._shard_mesh = mesh
         self._shard_layouts = {}
         self._sharded_fns = {}
+        # the resident copy is laid out for the dead mesh; the next
+        # fresh pack re-gathers from host over the survivors
+        self._resident = None
+        self._scatter_jit = None
         self.stats["burst_shard_degradations"] += 1
         if mesh is None:
             self.stats["burst_shard_serial_fallbacks"] += 1
@@ -1874,16 +1957,75 @@ class BurstSolver:
             self.stats["burst_shard_fetch_s"] = [0.0] * self.n_shards
         return self.n_shards
 
+    @staticmethod
+    def _layout_key(plan: BurstPlan):
+        st = plan.structure
+        return (id(st), st.generation, plan.C, plan.M, plan.G, plan.L,
+                plan.KC)
+
     def _layout_for(self, plan: BurstPlan):
         from ..parallel.sharded import BurstShardLayout
-        st = plan.structure
-        key = (id(st), st.generation, plan.C, plan.M, plan.G, plan.L,
-               plan.KC)
+        key = self._layout_key(plan)
         lay = self._shard_layouts.get(key)
         if lay is None:
-            lay = BurstShardLayout(plan, self.n_shards)
+            # feed the measured per-forest cycle cost when it was
+            # sampled under this structure generation — layout rebuilds
+            # happen only on structure/mesh change (or an explicit
+            # refresh_layouts), so this is where rebalancing lands
+            fc = self._forest_cost
+            cost = None
+            if (fc is not None
+                    and fc["generation"] == plan.structure.generation
+                    and fc["windows"] > 0 and len(fc["ewma"]) == plan.G):
+                cost = fc["ewma"]
+            import time as _time
+            t0 = _time.perf_counter()
+            lay = BurstShardLayout(plan, self.n_shards, forest_cost=cost)
+            if os.environ.get("KUEUE_BURST_DEBUG"):
+                print(f"layout rebuild: gen={plan.structure.generation} "
+                      f"Cs={lay.Cs} Gs={lay.Gs} cost={cost is not None} "
+                      f"{(_time.perf_counter() - t0)*1e3:.1f}ms",
+                      file=sys.stderr)
             self._shard_layouts = {key: lay}   # one structure at a time
+            self.stats["burst_layout_rebuilds"] = (
+                self.stats.get("burst_layout_rebuilds", 0) + 1)
+            if lay.cost_balanced:
+                self.stats["burst_layout_cost_balanced"] = (
+                    self.stats.get("burst_layout_cost_balanced", 0) + 1)
+            self.stats["burst_shard_cost_ratio"] = lay.cost_ratio
+            self.stats["burst_shard_cost"] = list(lay.shard_cost)
         return lay
+
+    def refresh_layouts(self):
+        """Drop cached shard layouts so the NEXT fresh pack re-partitions
+        the forests with the current cycle-cost EWMA.  Callers must hold
+        no in-flight handles (the driver's window boundary, a harness's
+        warmup/measure seam): a chained carry is laid out for the old
+        partition and dispatch_next refuses to cross layouts."""
+        self._shard_layouts = {}
+        self._resident = None
+
+    def _note_forest_activity(self, plan: BurstPlan, head_row):
+        """Fold one fetched window's decided heads into the per-forest
+        cycle-cost EWMA (keyed by structure generation).  head_row is in
+        GLOBAL layout ([K, C]; fetch inverse-permutes sharded planes),
+        so the sample is identical on the serial and sharded paths."""
+        hr = np.asarray(head_row)
+        if hr.ndim != 2:
+            return
+        cols = np.nonzero(hr >= 0)[1]
+        sample = np.bincount(
+            np.asarray(plan.arrays["forest_of_cq"])[cols],
+            minlength=plan.G).astype(np.float64)
+        fc = self._forest_cost
+        gen = plan.structure.generation
+        if (fc is None or fc["generation"] != gen
+                or len(fc["ewma"]) != plan.G):
+            self._forest_cost = {"generation": gen, "ewma": sample,
+                                 "windows": 1}
+        else:
+            fc["ewma"] = 0.7 * fc["ewma"] + 0.3 * sample
+            fc["windows"] += 1
 
     def _device(self):
         import jax
@@ -1970,12 +2112,151 @@ class BurstSolver:
                layout.Gs, runtime)
         fn = self._sharded_fns.get(key)
         if fn is None:
+            if os.environ.get("KUEUE_BURST_DEBUG"):
+                print(f"sharded fn miss: K={K} depth={st.depth} "
+                      f"L={plan.L} S={S} KC={plan.KC} "
+                      f"n_levels={plan.n_levels} Gs={layout.Gs} "
+                      f"runtime={runtime} cached={len(self._sharded_fns)}",
+                      file=sys.stderr)
             fn = sharded_burst_fn(
                 self._shard_mesh, K=K, depth=st.depth, L=plan.L, S=S,
                 KC=plan.KC, n_levels=plan.n_levels, G=layout.Gs,
                 runtime=max(0, runtime))
             self._sharded_fns[key] = fn
         return fn
+
+    def _row_sharding(self):
+        from jax.sharding import NamedSharding, PartitionSpec as P
+        return NamedSharding(self._shard_mesh, P("cq"))
+
+    def _scatter_rows_fn(self):
+        # one fused dispatch for ALL planes: per-plane jit calls cost
+        # ~7 ms each in SPMD dispatch overhead on a virtual-device mesh,
+        # which at 13 planes dwarfs the actual row updates
+        if self._scatter_jit is None:
+            self._scatter_jit = jax.jit(
+                lambda planes, rows, vals: tuple(
+                    a.at[rows].set(v) for a, v in zip(planes, vals)))
+        return self._scatter_jit
+
+    def _resident_inputs(self, plan: BurstPlan, layout, timers) -> dict:
+        """Sharded kernel inputs for a FRESH pack under the
+        shard-resident boundary (``KUEUE_TPU_RESIDENT``, default on):
+
+        - STATIC tier: permuted + device_put once per layout lifetime;
+        - SCATTER tier (row records + scan-state init planes): reused
+          on the mesh when this plan chains the resident copy's pack
+          token — only ``plan.dirty_cqs`` rows are scattered from host,
+          coalesced (journal.PackJournal.coalesce) and bucketed
+          (packing.scatter_pad) into ONE indexed update per plane;
+        - GLOBAL tier (dense cross-CQ ranks, preempt envelope):
+          re-uploaded every fresh pack.
+
+        ``KUEUE_TPU_RESIDENT_VERIFY=1`` asserts every scattered plane is
+        bit-identical to a full host permute (test harness switch).
+        Returns the merged name→array dict (device arrays for static +
+        scatter tiers, host arrays for the global tier)."""
+        import os
+        import time as _time
+        from ..parallel.sharded import (
+            _C_FILLS, _STATE_NAMES, SCATTER_PLANES, GLOBAL_PLANES)
+        from ..utils.journal import PackJournal
+        from .packing import scatter_pad
+        a = plan.arrays
+        sh = self._row_sharding()
+        stats = self.stats
+        dev_static = layout._static_dev
+        if dev_static is None:
+            t_s = _time.perf_counter()
+            host = layout.static_arrays(plan, timers)
+            dev_static = {k: jax.device_put(v, sh) for k, v in
+                          host.items()}
+            layout._static_dev = dev_static
+            layout._static_nbytes = sum(v.nbytes for v in host.values())
+            stats["burst_boundary_bytes_h2d"] += layout._static_nbytes
+            if os.environ.get("KUEUE_BURST_DEBUG"):
+                print(f"static tier upload: "
+                      f"{layout._static_nbytes/1e6:.1f}MB "
+                      f"{(_time.perf_counter() - t_s)*1e3:.1f}ms",
+                      file=sys.stderr)
+        stats["burst_boundary_bytes_equiv"] += layout._static_nbytes
+
+        res = self._resident
+        hit = (res is not None and res.layout is layout
+               and plan.prev_token is not None
+               and res.token == plan.prev_token
+               and plan.dirty_cqs is not None)
+        SCs = layout.n_shards * layout.Cs
+        full_bytes = sum((a[n].nbytes // max(1, plan.C)) * SCs
+                        for n in SCATTER_PLANES)
+        t0 = _time.perf_counter()
+        if hit:
+            planes = dict(res.planes)
+            dirty = np.asarray(plan.dirty_cqs)
+            D = int(dirty.size)
+            if D:
+                pos = layout.cq_pos[dirty]
+                order = np.argsort(pos, kind="stable")
+                cis = dirty[order]
+                rows = pos[order].astype(np.int32)
+                ranges = PackJournal.coalesce(rows.tolist())
+                Dp = scatter_pad(D)
+                rows_pad = (np.concatenate(
+                    [rows, np.repeat(rows[-1:], Dp - D)])
+                    if Dp != D else rows)
+                scat = self._scatter_rows_fn()
+                nb = 0
+                vals_all = []
+                for name in SCATTER_PLANES:
+                    vals = np.ascontiguousarray(a[name][cis])
+                    nb += vals.nbytes
+                    if Dp != D:
+                        vals = np.concatenate(
+                            [vals, np.repeat(vals[-1:], Dp - D, axis=0)])
+                    vals_all.append(vals)
+                new = scat(tuple(planes[n] for n in SCATTER_PLANES),
+                           rows_pad, tuple(vals_all))
+                planes.update(zip(SCATTER_PLANES, new))
+                stats["burst_resident_scatter_rows"] += D
+                stats["burst_resident_scatter_ranges"] += len(ranges)
+                stats["burst_boundary_bytes_h2d"] += nb
+            stats["burst_resident_hits"] += 1
+            stats["burst_resident_scatter_s"] += (
+                _time.perf_counter() - t0)
+            if os.environ.get("KUEUE_TPU_RESIDENT_VERIFY"):
+                for name in SCATTER_PLANES:
+                    want = layout.permute_rows(a[name], _C_FILLS[name])
+                    if not np.array_equal(np.asarray(planes[name]),
+                                          want):
+                        raise AssertionError(
+                            f"resident scatter drift in {name}")
+        else:
+            planes = {
+                name: jax.device_put(
+                    layout.permute_rows(a[name], _C_FILLS[name],
+                                        timers), sh)
+                for name in SCATTER_PLANES}
+            stats["burst_resident_misses"] += 1
+            stats["burst_boundary_bytes_h2d"] += full_bytes
+            if os.environ.get("KUEUE_BURST_DEBUG"):
+                print(f"resident miss: {full_bytes/1e6:.1f}MB "
+                      f"{(_time.perf_counter() - t0)*1e3:.1f}ms",
+                      file=sys.stderr)
+        stats["burst_boundary_bytes_equiv"] += full_bytes
+
+        glob = {}
+        for name in GLOBAL_PLANES:
+            host = layout.permute_rows(a[name], _C_FILLS[name], timers)
+            glob[name] = host
+            stats["burst_boundary_bytes_h2d"] += host.nbytes
+            stats["burst_boundary_bytes_equiv"] += host.nbytes
+        self._resident = (
+            _ResidentRows(layout, plan.pack_token, planes)
+            if plan.pack_token is not None else None)
+        merged = dict(dev_static)
+        merged.update(planes)
+        merged.update(glob)
+        return merged
 
     def _launch_sharded(self, plan: BurstPlan, K: int, runtime: int,
                         ext_release, ext_unpark, state, seq_base: int,
@@ -1984,18 +2265,39 @@ class BurstSolver:
         state are permuted into per-forest shard blocks (value-remapped
         so every rank/slot the kernel compares is carried verbatim —
         decisions stay bit-identical) and the shard_map-wrapped kernel
-        is dispatched once across the whole mesh."""
+        is dispatched once across the whole mesh.  With the resident
+        boundary on, the permuted row planes live on the mesh: a fresh
+        pack scatters only its dirty rows (``_resident_inputs``) and a
+        chained window reuses the cached device dict outright."""
+        import os
         import time as _time
+        from ..parallel.sharded import _STATE_NAMES
         layout = self._layout_for(plan)
         timers = self.stats.get("burst_shard_pack_s")
-        a = layout.plan_arrays(plan, timers)
-        if not permuted:
-            state = layout.permute_state(state, timers)
+        a = None
+        if os.environ.get("KUEUE_TPU_RESIDENT", "1") != "0":
+            cached = getattr(plan, "_resident_args", None)
+            if cached is not None and cached[0] is layout:
+                a = cached[1]
+            elif not permuted:
+                a = self._resident_inputs(plan, layout, timers)
+                plan._resident_args = (layout, a)
+            if a is not None and not permuted:
+                state = tuple(a[n] for n in _STATE_NAMES)
+        if a is None:
+            a = layout.plan_arrays(plan, timers)
+            if not permuted:
+                state = layout.permute_state(state, timers)
         (elig0, parked0, resume0, adm0, adm_seq0, adm_usage0,
          adm_uses0, death0, u_cq0) = state
         extr, extu = layout.permute_ext(ext_release, ext_unpark)
+        t_fn = _time.perf_counter()
         fn = self._sharded_fn(plan, layout, K, runtime)
         t0 = _time.perf_counter()
+        if (os.environ.get("KUEUE_BURST_DEBUG")
+                and t0 - t_fn > 0.05):
+            print(f"sharded fn build: {(t0 - t_fn)*1e3:.1f}ms",
+                  file=sys.stderr)
         out = fn(
             a["wl_req"], a["wl_rank"], a["wl_cycle_rank"],
             a["wl_prio"], a["wl_uidrank"], a["vec_ok"],
@@ -2013,6 +2315,11 @@ class BurstSolver:
             a["members"], a["cand_rows"], a["cand_lmem"],
             a["self_lmem"],
             extr, extu)
+        if os.environ.get("KUEUE_BURST_DEBUG"):
+            t1 = _time.perf_counter()
+            if t1 - t0 > 0.1:
+                print(f"sharded dispatch call: {(t1 - t0)*1e3:.1f}ms "
+                      f"(trace+lower on first shapes)", file=sys.stderr)
         self.stats["burst_dispatches"] += 1
         self.stats["burst_cycles_decided"] += K
         self.stats["burst_sharded_dispatches"] = (
@@ -2057,6 +2364,13 @@ class BurstSolver:
         if handle.sharded != (self.n_shards > 1
                               and self._shard_mesh is not None):
             return None
+        # nor across layouts: after lose_devices/refresh_layouts the
+        # next _layout_for would re-partition and the carry's shard
+        # blocks no longer line up with the new permutation
+        if (handle.sharded and handle.layout is not None
+                and self._shard_layouts.get(
+                    self._layout_key(handle.plan)) is not handle.layout):
+            return None
         seq_base = handle.seq_base + handle.K
         # same headroom discipline as pack_burst's overflow gate
         if seq_base + max(K_BURST_LADDER) >= (1 << 20):
@@ -2070,6 +2384,32 @@ class BurstSolver:
         return self._launch(handle.plan, handle.K, handle.runtime,
                             ext_release, ext_unpark, state, seq_base,
                             speculative=True, permuted=handle.sharded)
+
+    def fetch_flags(self, handle: BurstHandle):
+        """Flags-first half of the fetch: block only for the tiny
+        replicated (dirty, dirty_reason) planes — the speculation gate's
+        whole input — park the final carry for ``dispatch_next``, and
+        start async device→host copies of the decision planes.  The
+        caller can then chain the next window's dispatch BEFORE the full
+        ``fetch`` assembles decisions, so each shard's decision transfer
+        overlaps the chained kernel and the host apply loop instead of
+        serializing ahead of them."""
+        import jax
+        if handle.decisions is not None:
+            return handle.decisions[5], handle.decisions[6]
+        if handle.flags is not None:
+            return handle.flags
+        out = handle.pending
+        handle.carry = out[-1]
+        dirty = jax.device_get(out[5])
+        dirty_reason = jax.device_get(out[6])
+        for arr in out[:5]:
+            try:
+                arr.copy_to_host_async()
+            except Exception:
+                pass   # overlap is best-effort; fetch still blocks
+        handle.flags = (dirty, dirty_reason)
+        return handle.flags
 
     def fetch(self, handle: BurstHandle):
         """Block for a dispatched window's decisions.  Returns the numpy
@@ -2110,6 +2450,8 @@ class BurstSolver:
         else:
             handle.decisions = tuple(jax.device_get(out[:-1]))
         handle.pending = None
+        # per-forest cycle-cost sample for the next layout's LPT
+        self._note_forest_activity(handle.plan, handle.decisions[0])
         dt = _time.perf_counter() - t0
         if handle.speculative:
             # residual wait not hidden behind the previous window's

@@ -135,6 +135,14 @@ def _bucket(n: int, minimum: int = 8) -> int:
     return max(minimum, 1 << math.ceil(math.log2(max(1, n))))
 
 
+def scatter_pad(n: int, minimum: int = 8) -> int:
+    """Padded row count for a dirty-row scatter onto device-resident
+    state: every distinct update size is an XLA compilation, so the
+    count is bucketed to powers of two and the tail padded with
+    repeated last-row writes (idempotent — same index, same value)."""
+    return _bucket(n, minimum=minimum)
+
+
 def scaled_usage_row(st: PackedStructure, cq_live) -> Optional[np.ndarray]:
     """One CQ's live usage scaled onto the packed flavor-resource axis:
     [F] int32, or None when not exactly representable (unknown

@@ -234,6 +234,31 @@ _N_FILLS = {
     "has_blim": False,
 }
 _STATE_FILLS = (False, False, False, False, 0, 0, False, _I32_MAX, 0)
+_STATE_NAMES = ("elig0", "parked0", "resume0", "adm0", "adm_seq0",
+                "adm_usage0", "adm_uses0", "death0", "u_cq0")
+
+# Residency tiers for the shard-resident boundary (BurstSolver keeps the
+# permuted kernel inputs on the mesh between windows; only the tier that
+# actually changed crosses the host→device boundary at a fresh pack):
+#
+# - STATIC:  pure functions of (structure generation, M, KC) — the
+#   layout's value-remapped tables plus the quota plane and per-CQ
+#   structure facts.  Permuted + uploaded once per layout lifetime.
+# - SCATTER: per-record row facts.  The delta pack re-walks only
+#   journal-dirty CQs and splices every other record verbatim
+#   (_concat_row_fields), so for a chained delta pack these planes are
+#   bit-identical outside the dirty rows — only those rows scatter.
+# - GLOBAL:  globally recomputed each pack — dense cross-CQ ranks
+#   (cycle/uid), the reservation-seq plane, and the modeling envelope
+#   (preempt_ok depends on global scalars).  Always re-uploaded; all
+#   are small relative to the row tier.
+_ROW_STATIC = ("nominal_cq", "npb_cq", "slot_fr", "slot_valid",
+               "cq_can_preempt_borrow", "wcq_lower", "rwc_enabled",
+               "rwc_only_lower", "self_lmem")
+SCATTER_PLANES = ("wl_req", "wl_rank", "wl_prio", "vec_ok", "strict_cq",
+                  "elig0", "parked0", "resume0", "adm0", "adm_usage0",
+                  "adm_uses0", "death0", "u_cq0")
+GLOBAL_PLANES = ("wl_cycle_rank", "wl_uidrank", "adm_seq0", "preempt_ok")
 
 
 class BurstShardLayout:
@@ -246,21 +271,25 @@ class BurstShardLayout:
     value — so partitioning whole forests onto shards, with the dirty
     reduction as a psum, reproduces the serial decisions bit-for-bit.
 
-    The layout assigns forests to shards greedily (largest CQ count
-    first onto the least-loaded shard), gives every shard equally padded
+    The layout assigns forests to shards greedily onto the least-loaded
+    shard — by CQ count, or by measured per-forest cycle cost when the
+    solver has an EWMA from prior windows (``forest_cost``; assignment
+    never affects decisions, every rank is carried by value).  It gives
+    every shard equally padded
     local index spaces (Cs CQ slots, Gs forest rows, Ns = Cs + Hs quota
     nodes with CQ nodes first — the kernel's ``usage[:C]`` convention),
     and VALUE-REMAPS the member/candidate tables into local ids at
     identical slot positions, so ``tgt_words`` bit j still means global
     candidate slot j and the driver's apply path is untouched."""
 
-    def __init__(self, plan, n_shards: int):
+    def __init__(self, plan, n_shards: int, forest_cost=None):
         a = plan.arrays
         st = plan.structure
         C, M, G, L, KC = plan.C, plan.M, plan.G, plan.L, plan.KC
         S = int(n_shards)
         self.n_shards = S
         self.M = M
+        self._static_dev = None   # device-resident statics (solver tier)
         forest_of_cq = np.asarray(a["forest_of_cq"])
         parent = np.asarray(a["parent"])
         node_level = np.asarray(a["node_level"])
@@ -270,14 +299,28 @@ class BurstShardLayout:
         N = parent.shape[0]
         forest_of_node = np.asarray(st.forest_of_node)
 
-        # greedy LPT: big forests first onto the least-loaded shard
+        # greedy LPT: big forests first onto the least-loaded shard.
+        # "Big" is CQ count by default; with a measured per-forest cycle
+        # cost (EWMA of decided heads per window) the cost is the load,
+        # with a small size term so never-fired forests still spread.
         counts = np.bincount(forest_of_cq, minlength=G)
-        load = [0] * S
+        if forest_cost is not None and len(forest_cost) == G:
+            weight = (np.asarray(forest_cost, dtype=np.float64)
+                      + 1e-6 * counts)
+            self.cost_balanced = True
+        else:
+            weight = counts.astype(np.float64)
+            self.cost_balanced = False
+        load = [0.0] * S
         forests_of: list[list[int]] = [[] for _ in range(S)]
-        for g in sorted(range(G), key=lambda g: (-int(counts[g]), g)):
+        for g in sorted(range(G), key=lambda g: (-float(weight[g]), g)):
             s = min(range(S), key=lambda i: (load[i], i))
             forests_of[s].append(g)
-            load[s] += int(counts[g])
+            load[s] += float(weight[g])
+        self.shard_cost = [round(x, 6) for x in load]
+        mean_load = sum(load) / max(1, S)
+        self.cost_ratio = (round(max(load) / mean_load, 4)
+                           if mean_load > 0 else 1.0)
         for fl in forests_of:
             fl.sort()
         shard_of_forest = np.zeros(max(G, 1), dtype=np.int32)
@@ -405,25 +448,36 @@ class BurstShardLayout:
         return (ax1(np.asarray(ext_release), self.cq_perm, 0),
                 ax1(np.asarray(ext_unpark), self.forest_perm, False))
 
+    def static_arrays(self, plan, timers=None):
+        """The permuted STATIC-tier planes: the value-remapped layout
+        tables plus every input that is a pure function of (structure
+        generation, M, KC).  Cached on the layout — valid for its whole
+        lifetime, which is exactly one (generation, C, M, G, L, KC)."""
+        cached = getattr(self, "_static_host", None)
+        if cached is not None:
+            return cached
+        a = plan.arrays
+        out = dict(self._static)
+        for name in _ROW_STATIC:
+            out[name] = self.permute_rows(a[name], _C_FILLS[name], timers)
+        for name, fill in _N_FILLS.items():
+            out[name] = self.permute_nodes(a[name], fill, timers)
+        self._static_host = out
+        return out
+
     def plan_arrays(self, plan, timers=None):
         """The permuted kernel-input dict for ``plan``, cached on the
-        plan object (chained windows reuse it untouched)."""
+        plan object (chained windows reuse it untouched).  Scan-state
+        planes flow through permute_state, not this dict."""
         cached = getattr(plan, "_shard_arrays", None)
         if cached is not None and cached[0] is self:
             return cached[1]
         a = plan.arrays
-        out = dict(self._static)
-        for name, fill in _C_FILLS.items():
-            if name in ("self_lmem",):
-                out[name] = self.permute_rows(a[name], fill, timers)
-                continue
-            if name in ("elig0", "parked0", "resume0", "adm0",
-                        "adm_seq0", "adm_usage0", "adm_uses0",
-                        "death0", "u_cq0"):
+        out = dict(self.static_arrays(plan, timers))
+        for name in SCATTER_PLANES + GLOBAL_PLANES:
+            if name in _STATE_NAMES:
                 continue   # scan state flows through permute_state
-            out[name] = self.permute_rows(a[name], fill, timers)
-        for name, fill in _N_FILLS.items():
-            out[name] = self.permute_nodes(a[name], fill, timers)
+            out[name] = self.permute_rows(a[name], _C_FILLS[name], timers)
         plan._shard_arrays = (self, out)
         return out
 

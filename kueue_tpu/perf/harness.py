@@ -274,6 +274,43 @@ def burst_boundary_report(bstats: dict) -> dict:
         "rows_reused": bstats.get("rows_reused", 0),
         "rows_repacked": bstats.get("rows_repacked", 0),
         "delta_pack_s": round(bstats.get("delta_pack_s", 0.0), 4),
+        # shard-resident boundary: fresh packs that reused the on-mesh
+        # row planes (scattering only dirty rows, coalesced into
+        # ranges) vs full re-uploads, and the host→device bytes the
+        # residency actually paid vs the upload-everything equivalent
+        "resident_hits": bstats.get("burst_resident_hits", 0),
+        "resident_misses": bstats.get("burst_resident_misses", 0),
+        "resident_scatter_rows": bstats.get(
+            "burst_resident_scatter_rows", 0),
+        "resident_scatter_ranges": bstats.get(
+            "burst_resident_scatter_ranges", 0),
+        "journal_dirty_ranges": bstats.get(
+            "burst_journal_dirty_ranges", 0),
+        "boundary_bytes_h2d": bstats.get("burst_boundary_bytes_h2d", 0),
+        "boundary_bytes_equiv": bstats.get(
+            "burst_boundary_bytes_equiv", 0),
+    }
+
+
+def shard_imbalance_report(bstats: dict) -> dict:
+    """The artifact mesh block's shard-imbalance counters: how the
+    cost-balanced forest partition spread measured cycle cost across
+    shards (max/mean ratio; 1.0 = perfectly even), the per-shard fetch
+    waits the boundary pays, and the shard-resident reuse counters."""
+    cost = bstats.get("burst_shard_cost")
+    return {
+        "layout_rebuilds": bstats.get("burst_layout_rebuilds", 0),
+        "layouts_cost_balanced": bstats.get(
+            "burst_layout_cost_balanced", 0),
+        "forest_cost_max_mean_ratio": bstats.get(
+            "burst_shard_cost_ratio", 0.0),
+        "shard_cost": list(cost) if cost else [],
+        "shard_fetch_wait_s": [
+            round(x, 4) for x in bstats.get("burst_shard_fetch_s", [])],
+        "shard_pack_s": [
+            round(x, 4) for x in bstats.get("burst_shard_pack_s", [])],
+        "resident_hits": bstats.get("burst_resident_hits", 0),
+        "resident_misses": bstats.get("burst_resident_misses", 0),
     }
 
 

@@ -78,13 +78,23 @@ class PackJournal:
             s = self.soft[cq_name] = set()
         s.add(key)
 
-    def drain_into(self, dirty: set, soft: dict) -> bool:
+    def drain_into(self, dirty: set, soft: dict, row_of: dict = None,
+                   ranges_out: list = None) -> bool:
         """Merge this journal's content into the caller's accumulators
         and reset it; returns the dirty-all flag that was set.  Soft
         roundtrip keys for CQs in the hard dirty set are dropped — those
         CQs are re-walked anyway, so their keys would only bloat the
-        O(1) verify set."""
+        O(1) verify set.
+
+        ``row_of`` maps CQ name → packed row index; when given together
+        with ``ranges_out``, the drained hard-dirty rows are coalesced
+        into ``[lo, hi)`` ranges (see :meth:`coalesce`) and appended, so
+        the scatter that pushes the dirty rows back to the device can
+        issue one transfer per contiguous run instead of one per row."""
         was_all = self.dirty_all or self.tainted
+        if row_of is not None and ranges_out is not None and self.dirty:
+            rows = sorted(row_of[n] for n in self.dirty if n in row_of)
+            ranges_out.extend(self.coalesce(rows))
         dirty |= self.dirty
         for name, keys in self.soft.items():
             if name in dirty:
@@ -101,6 +111,28 @@ class PackJournal:
         self.dirty_all = False
         self.tainted = False
         return was_all
+
+    @staticmethod
+    def coalesce(rows) -> list:
+        """Coalesce sorted row indices into ``[lo, hi)`` ranges.
+
+        Adjacent dirty rows are the common case (cohort members pack
+        consecutively), and the device update for a contiguous run is a
+        single slice transfer — N singleton scatters would each pay a
+        dispatch.  Duplicate indices collapse into their range."""
+        out: list[tuple[int, int]] = []
+        lo = hi = None
+        for r in rows:
+            r = int(r)
+            if hi is not None and r <= hi:
+                hi = max(hi, r + 1)
+                continue
+            if lo is not None:
+                out.append((lo, hi))
+            lo, hi = r, r + 1
+        if lo is not None:
+            out.append((lo, hi))
+        return out
 
 
 # ---------------------------------------------------------------------------

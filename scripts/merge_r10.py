@@ -1,0 +1,49 @@
+"""Merge the two crossover tails into MULTICHIP_r10.json.
+
+One-shot helper for the r10 artifact: takes the 1000-CQ crossover tail
+and the budgeted 10k-CQ tail (both produced by ``northstar_e2e.py
+--burst --crossover ...``) and wraps them as::
+
+    { metric, unit, value, best_solver_path, mesh, cqs,
+      runs: { cqs_1000: <tail>, cqs_10000_budgeted: <tail> } }
+
+The top-level value/mesh come from the 1000-CQ run (the north-star
+scale); the wrapper deliberately avoids the ``scenarios`` key, which
+the artifact validator reserves for chaos tables.
+
+Usage:
+    python scripts/merge_r10.py <tail_1000.json> <tail_10k.json> <out>
+"""
+
+from __future__ import annotations
+
+import json
+import sys
+
+
+def main() -> int:
+    if len(sys.argv) != 4:
+        print(__doc__, file=sys.stderr)
+        return 2
+    with open(sys.argv[1]) as f:
+        t1k = json.load(f)
+    with open(sys.argv[2]) as f:
+        t10k = json.load(f)
+    out = {
+        "metric": t1k.get("metric", "northstar_e2e_cycle_p99"),
+        "unit": t1k.get("unit", "ms"),
+        "value": t1k.get("value"),
+        "best_solver_path": t1k.get("best_solver_path"),
+        "cqs": t1k.get("cqs"),
+        "mesh": t1k.get("mesh"),
+        "runs": {"cqs_1000": t1k, "cqs_10000_budgeted": t10k},
+    }
+    with open(sys.argv[3], "w") as f:
+        json.dump(out, f, indent=1)
+        f.write("\n")
+    print(f"wrote {sys.argv[3]}")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

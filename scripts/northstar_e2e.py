@@ -43,12 +43,32 @@ def _peek_int_flag(argv, flag: str) -> int:
     return n
 
 
+def _peek_arm_list(argv, flag: str) -> int:
+    """Max shard count named in a comma-list flag (e.g. --crossover
+    1,2,4,8) from raw argv — same pre-jax constraint as _peek_int_flag."""
+    n = 0
+    for i, a in enumerate(argv):
+        v = None
+        if a == flag and i + 1 < len(argv):
+            v = argv[i + 1]
+        elif a.startswith(flag + "="):
+            v = a.split("=", 1)[1]
+        if v:
+            for part in v.split(","):
+                try:
+                    n = max(n, int(part))
+                except ValueError:
+                    pass
+    return n
+
+
 # sharding must be configured BEFORE jax initializes its backend (the
 # kueue_tpu import below pulls jax in): on a CPU host the only way to
 # get a multi-device mesh is --xla_force_host_platform_device_count
 _shards = _peek_int_flag(sys.argv[1:], "--shards")
 _ab_shards = _peek_int_flag(sys.argv[1:], "--ab-shards")
-_n_dev = max(_shards, _ab_shards)
+_xover = _peek_arm_list(sys.argv[1:], "--crossover")
+_n_dev = max(_shards, _ab_shards, _xover)
 if _n_dev > 1:
     _xf = os.environ.get("XLA_FLAGS", "")
     if "xla_force_host_platform_device_count" not in _xf:
@@ -224,18 +244,40 @@ def run_burst_path(args, backend: str) -> dict:
     if plan is not None:
         F = max(1, len(st.fr_index))
         for K in K_BURST_LADDER:
-            bs.run(plan, K, args.runtime,
-                   np.zeros((K, plan.C, F), np.int32),
-                   np.zeros((K, plan.G), bool))
+            extr = np.zeros((K, plan.C, F), np.int32)
+            extu = np.zeros((K, plan.G), bool)
+            h = bs.dispatch(plan, K, args.runtime, extr, extu)
+            bs.fetch_flags(h)
+            # chain one speculative window so the pipeline's
+            # carry-rebase path is compiled here, not at the first
+            # measured boundary that speculates
+            h2 = bs.dispatch_next(h, extr, extu)
+            bs.fetch(h)
+            if h2 is not None:
+                bs.fetch(h2)
         bs.stats = {k: ([0.0] * len(v) if isinstance(v, list)
                         else 0 if isinstance(v, int) else 0.0)
                     for k, v in bs.stats.items()}
+        bs._resident = None
         d._burst_m = plan.M
     d._burst_solver = bs
     warmup_s = time.perf_counter() - t_w
     print(f"solver+burst warmup {warmup_s:.1f}s", file=sys.stderr)
 
+    # The frozen object graph keeps gen-2 sweeps off the immortal build
+    # (see build()), but the run itself RETAINS per-cycle stats — the
+    # unfrozen heap grows all run and periodic gen-2 pauses grow with
+    # it (~0.5s at cycle 5 to ~2s at cycle 92 at 1000 CQs), drowning
+    # the boundary costs the crossover compares.  Collection is paused
+    # for the measured phase on every arm equally; refcounting still
+    # frees the per-cycle churn, and the cyclic leftovers are bounded
+    # by the run length (collected by with_trials between trials).
+    gc.disable()
+
     inject_at = args.inject_at if args.inject_at >= 0 else args.cycles // 3
+    budget_s = float(getattr(args, "budget_s", 0.0) or 0.0)
+    completed = True
+    t_run0 = time.perf_counter()
     all_stats = []
     cycle_times = []
     last_t = time.perf_counter()
@@ -260,6 +302,12 @@ def run_burst_path(args, backend: str) -> dict:
 
     injected = False
     while len(all_stats) < args.cycles:
+        if budget_s and time.perf_counter() - t_run0 > budget_s:
+            completed = False
+            print(f"budget {budget_s:.0f}s exhausted after "
+                  f"{len(all_stats)}/{args.cycles} cycles",
+                  file=sys.stderr)
+            break
         if not injected and len(all_stats) >= inject_at:
             n = preemptor_wave(clock.t)
             total += n
@@ -267,6 +315,10 @@ def run_burst_path(args, backend: str) -> dict:
             print(f"cycle {len(all_stats)}: injected {n} preemptors",
                   file=sys.stderr)
         target = args.cycles if injected else inject_at
+        if budget_s:
+            # budgeted runs chunk the window stream so the wall check
+            # fires between dispatches instead of after a whole phase
+            target = min(target, len(all_stats) + 8)
         base = len(all_stats)
         ext: dict = {}
         for j, s in enumerate(all_stats):
@@ -335,7 +387,14 @@ def run_burst_path(args, backend: str) -> dict:
         pre = dict(d._burst_solver.stats)
         n_touch = max(1, min(10, args.cqs))
         t_adm = 0
+        rounds_run = 0
         for t in range(trickle):
+            if budget_s and time.perf_counter() - t_run0 > budget_s:
+                completed = False
+                print(f"budget {budget_s:.0f}s exhausted after trickle "
+                      f"round {t}/{trickle}", file=sys.stderr)
+                break
+            rounds_run += 1
             for i in range(n_touch):
                 total += 1
                 d.create_workload(Workload(
@@ -359,10 +418,12 @@ def run_burst_path(args, backend: str) -> dict:
             for k in ("burst_pack_s", "burst_packs", "burst_full_packs",
                       "burst_delta_packs", "delta_pack_s", "rows_reused",
                       "rows_repacked")}
-        trickle_stats["rounds"] = trickle
+        trickle_stats["rounds"] = rounds_run
+        trickle_stats["rounds_requested"] = trickle
         trickle_stats["cqs_touched_per_round"] = n_touch
         trickle_stats["admitted"] = t_adm
 
+    gc.enable()
     # headline percentiles cover the backlog-drain phase only (the
     # r06-comparable number); the fill/trickle phases report their own
     # boundary costs through the pack counters
@@ -384,11 +445,15 @@ def run_burst_path(args, backend: str) -> dict:
         "skipped": sum(len(s.skipped) for s in all_stats),
         "workloads": total,
         "cycles_run": len(all_stats),
+        "completed": completed,
         "warmup_s": round(warmup_s, 1),
         "burst_stats": dict(d._burst_solver.stats),
         "boundary_pipeline": burst_boundary_report(d._burst_solver.stats),
         "solver_stats": dict(d.scheduler.solver.stats),
     }
+    if budget_s:
+        out["budget_s"] = budget_s
+        out["elapsed_s"] = round(time.perf_counter() - t_run0, 1)
     if trickle > 0:
         out["trickle"] = trickle_stats
     print(f"burst[{backend}] stats: {d._burst_solver.stats}",
@@ -644,6 +709,16 @@ def main():
                          "INTERLEAVED in one process (drift-fair A/B) "
                          "and report both arms plus a shard_compare "
                          "block with cross-arm decision identity")
+    ap.add_argument("--crossover", default=None,
+                    help="comma list of shard counts (e.g. 1,2,4,8; "
+                         "1 = the single-device serial control) run "
+                         "INTERLEAVED per trial block (drift-fair) "
+                         "with a per-arm crossover curve in the JSON "
+                         "tail")
+    ap.add_argument("--budget-s", type=float, default=0.0,
+                    help="per-trial wall budget in seconds; a run that "
+                         "exhausts it stops at the next window "
+                         "boundary and is recorded completed=false")
     ap.add_argument("--require-accel", action="store_true",
                     help="abort (exit 1) if no accelerator platform is "
                          "reachable instead of producing CPU-only "
@@ -661,7 +736,89 @@ def main():
     # artifact the round-2 verdict asked for
     results = []
     shard_compare = None
-    if args.burst and args.ab_shards > 1:
+    crossover = None
+    if args.burst and args.crossover:
+        # the shard crossover curve: every arm (single-device serial
+        # control included) runs back to back inside each trial block,
+        # so machine drift lands on all arms equally; each arm's p99
+        # is the median trial, and cross-arm decision identity is
+        # required over every run that completed the full cycle count
+        from kueue_tpu.perf.harness import shard_imbalance_report
+        backend = ("cpu" if args.burst_backend == "both"
+                   else args.burst_backend)
+        arms = sorted({max(1, int(x))
+                       for x in args.crossover.split(",") if x.strip()})
+        runs = {n: [] for n in arms}
+        for _ in range(max(1, args.trials)):
+            for n_sh in arms:
+                args.shards = 0 if n_sh == 1 else n_sh
+                runs[n_sh].append(run_burst_path(args, backend=backend))
+                gc.unfreeze()
+                gc.collect()
+        args.shards = 0
+        sums = {n: summarize_trials(runs[n]) for n in arms}
+        results.extend(sums[n] for n in arms)
+        curve = []
+        for n in arms:
+            s = sums[n]
+            entry = {
+                "shards": n,
+                "p50_ms": s["p50_ms"],
+                "p99_ms": s["p99_ms"],
+                "p99_ms_range": s["p99_ms_range"],
+                "decisions_stable": s["decisions_stable"],
+                "completed": s.get("completed", True),
+                "cycles_run": s.get("cycles_run", 0),
+            }
+            if "elapsed_s" in s:
+                entry["elapsed_s"] = s["elapsed_s"]
+            if "trickle" in s:
+                entry["trickle_rounds"] = s["trickle"]["rounds"]
+                entry["trickle_rounds_requested"] = \
+                    s["trickle"]["rounds_requested"]
+            bsh = s.get("burst_stats", {})
+            if n > 1:
+                entry["imbalance"] = shard_imbalance_report(bsh)
+                entry["boundary_bytes_h2d"] = bsh.get(
+                    "burst_boundary_bytes_h2d", 0)
+                entry["boundary_bytes_equiv"] = bsh.get(
+                    "burst_boundary_bytes_equiv", 0)
+            curve.append(entry)
+        # budget-cut runs stop at different cycles and are excluded
+        # from the identity check, not from the curve
+        done = [r for n in arms for r in runs[n]
+                if r.get("completed", True)]
+        identical = bool(done) and all(
+            (r["admitted"], r["preempted"], r["skipped"]) ==
+            (done[0]["admitted"], done[0]["preempted"],
+             done[0]["skipped"]) for r in done)
+        crossover = {
+            "arms": arms,
+            "trials_per_arm": len(runs[arms[0]]),
+            "curve": curve,
+            "decisions_identical_across_arms": identical,
+        }
+        if args.budget_s:
+            crossover["budget_s"] = args.budget_s
+        sharded_sums = [sums[n] for n in arms if n > 1]
+        if sharded_sums:
+            crossover["sharded_completed_within_budget"] = all(
+                s.get("completed", True) for s in sharded_sums)
+        ctrl = sums.get(1)
+        if ctrl is not None:
+            crossover["control_p99_ms"] = ctrl["p99_ms"]
+            crossover["control_completed"] = ctrl.get("completed", True)
+            done_sharded = [s for s in sharded_sums
+                            if s.get("completed", True)]
+            if done_sharded:
+                best = min(done_sharded, key=lambda s: s["p99_ms"])
+                crossover["best_sharded_shards"] = next(
+                    n for n in arms if n > 1 and sums[n] is best)
+                crossover["best_sharded_p99_ms"] = best["p99_ms"]
+                crossover["sharded_beats_serial_p99"] = (
+                    ctrl.get("completed", True)
+                    and best["p99_ms"] < ctrl["p99_ms"])
+    elif args.burst and args.ab_shards > 1:
         # drift-fair shard A/B: alternate N-shard/serial burst trials
         # in one process (same rationale as --ab-pipeline) and require
         # cross-arm decision identity — the tentpole's bit-identical
@@ -769,15 +926,25 @@ def main():
     if not args.device and not args.fair_sharing:
         results.append(with_trials(
             lambda: run_path(args, use_device=False), args))
+    mesh_shards = max(args.shards, args.ab_shards,
+                      (crossover or {}).get("arms", [0])[-1])
     tail = {
         "metric": "northstar_e2e_cycle_p99",
         "unit": "ms",
         "cqs": args.cqs,
         "flavors": args.flavors, "resources": args.resources,
-        "mesh": mesh_info(max(args.shards, args.ab_shards)),
+        "mesh": mesh_info(mesh_shards),
     }
     if shard_compare is not None:
         tail["shard_compare"] = shard_compare
+    if crossover is not None:
+        tail["crossover"] = crossover
+        # the mesh block is the self-describing home for shard-health
+        # counters; surface the widest sharded arm's imbalance there
+        for e in reversed(crossover["curve"]):
+            if e.get("imbalance"):
+                tail["mesh"]["shard_imbalance"] = e["imbalance"]
+                break
     for r in results:
         tail[r["path"]] = {k: v for k, v in r.items() if k != "path"}
     piped_r = next((r for r in results
@@ -866,7 +1033,11 @@ def main():
     solver_rs = [r for r in results
                  if r["path"] not in ("host", "fs-host")]
     if solver_rs:
-        best = min(solver_rs, key=lambda r: r["p99_ms"])
+        # a budget-cut run's partial-phase p99 is not comparable to a
+        # full run's; only promote it to the headline when nothing
+        # finished
+        done_rs = [r for r in solver_rs if r.get("completed", True)]
+        best = min(done_rs or solver_rs, key=lambda r: r["p99_ms"])
         tail["value"] = best["p99_ms"]
         tail["best_solver_path"] = best["path"]
         if host_r is not None:
@@ -884,8 +1055,11 @@ def main():
             r.get("fs_full_cycles", 1) > 0 or r["path"] == "fs-host"
             for r in results)
     else:
+        # a budget-cut run may stop before the preemptor wave; only
+        # completed runs owe the hard-path proof
         tail["hard_paths_exercised"] = all(
-            r["preempted"] > 0 and r["skipped"] > 0 for r in results)
+            r["preempted"] > 0 and r["skipped"] > 0 for r in results
+            if r.get("completed", True))
     print(json.dumps(tail))
     if args.out:
         with open(args.out, "w") as f:

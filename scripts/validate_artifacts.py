@@ -19,6 +19,15 @@ unreadable number.  Checks are tiered:
                      consistent with the per-scenario verdicts.
   NORTHSTAR_* /
   MULTICHIP_r08+   — additionally: ``metric`` + numeric ``value``.
+  MULTICHIP_r10+   — additionally: at least one ``crossover`` block
+                     (top level or per-``runs`` entry) whose ``curve``
+                     lists one entry per shard arm with int ``shards``,
+                     numeric ``p99_ms``, bool ``decisions_stable`` and
+                     bool ``completed``, plus a bool
+                     ``decisions_identical_across_arms``; sharded
+                     arms (shards > 1) also carry an ``imbalance``
+                     object and the ``boundary_bytes_h2d`` /
+                     ``boundary_bytes_equiv`` pair.
 
 Usage:
     python scripts/validate_artifacts.py [paths...]
@@ -32,6 +41,7 @@ from __future__ import annotations
 import glob
 import json
 import os
+import re
 import sys
 
 
@@ -111,6 +121,59 @@ def _check_metric_value(d, path, out):
         _err(out, path, "missing numeric 'value'")
 
 
+def _crossover_blocks(d):
+    """Every SHARD-crossover block in an artifact: top level, or one
+    per entry of a multi-scenario ``runs`` wrapper.  Keyed on the
+    ``curve``/``arms`` shape — ROOFLINE_* reuses the 'crossover' name
+    for the accel break-even model, which is not this schema."""
+    blocks = []
+    c = d.get("crossover")
+    if isinstance(c, dict) and ("curve" in c or "arms" in c):
+        blocks.append(("crossover", c))
+    runs = d.get("runs")
+    if isinstance(runs, dict):
+        for name, r in runs.items():
+            if not isinstance(r, dict):
+                continue
+            c = r.get("crossover")
+            if isinstance(c, dict) and ("curve" in c or "arms" in c):
+                blocks.append((f"runs.{name}.crossover", c))
+    return blocks
+
+
+def _check_crossover(label, c, path, out):
+    curve = c.get("curve")
+    if not isinstance(curve, list) or len(curve) < 2:
+        _err(out, path, f"'{label}.curve' must list >= 2 shard arms")
+        return
+    for e in curve:
+        if not isinstance(e, dict):
+            _err(out, path, f"'{label}.curve' entries must be objects")
+            continue
+        n = e.get("shards")
+        if not isinstance(n, int) or n < 1:
+            _err(out, path, f"'{label}' arm missing int 'shards' >= 1")
+            continue
+        if not isinstance(e.get("p99_ms"), (int, float)):
+            _err(out, path, f"'{label}' arm {n}: missing numeric "
+                 "'p99_ms'")
+        for k in ("decisions_stable", "completed"):
+            if not isinstance(e.get(k), bool):
+                _err(out, path, f"'{label}' arm {n}: missing bool "
+                     f"'{k}'")
+        if n > 1:
+            if not isinstance(e.get("imbalance"), dict):
+                _err(out, path, f"'{label}' arm {n}: missing "
+                     "'imbalance' object")
+            for k in ("boundary_bytes_h2d", "boundary_bytes_equiv"):
+                if not isinstance(e.get(k), int):
+                    _err(out, path, f"'{label}' arm {n}: missing int "
+                         f"'{k}'")
+    if not isinstance(c.get("decisions_identical_across_arms"), bool):
+        _err(out, path, f"'{label}' missing bool "
+             "'decisions_identical_across_arms'")
+
+
 # generator scripts that postdate the schema convention (metric+value
 # at top level); older BENCH_/MULTICHIP_r01-05 wrappers predate it and
 # only get the common checks
@@ -131,8 +194,15 @@ def validate(path: str) -> list[str]:
     # if the file was renamed
     if base.startswith("CHAOS_") or "scenarios" in d:
         _check_chaos(d, path, out)
-    if base.startswith(_STRICT_PREFIXES) or base == "MULTICHIP_R08.JSON":
+    m = re.match(r"MULTICHIP_R(\d+)", base)
+    if base.startswith(_STRICT_PREFIXES) or (m and int(m.group(1)) >= 8):
         _check_metric_value(d, path, out)
+    blocks = _crossover_blocks(d)
+    for label, c in blocks:
+        _check_crossover(label, c, path, out)
+    if m and int(m.group(1)) >= 10 and not blocks:
+        _err(out, path, "MULTICHIP_r10+ artifacts must carry a "
+             "'crossover' block")
     return out
 
 

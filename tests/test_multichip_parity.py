@@ -144,6 +144,116 @@ def test_env_var_activates_sharding(monkeypatch):
     assert de._burst_solver.stats["burst_sharded_dispatches"] >= 1
 
 
+@needs_8_devices
+def test_burst_8shard_resident_multiwindow_parity(monkeypatch):
+    """Shard-resident boundary: mid-run arrivals force fresh (delta)
+    packs across a multi-window drain, so the resident device copy is
+    actually reused — only dirty rows scattered — and decisions stay
+    bit-identical to serial and host.  VERIFY asserts, inside the
+    solver, that every scattered plane equals a full host permute."""
+    monkeypatch.setenv("KUEUE_TPU_RESIDENT_VERIFY", "1")
+    spec = sustained_spec()
+    inject = {36: mk("boss", "lq-0-0", 4000, prio=100, t=500.0)}
+    dh, ch = build(spec)
+    ds, cs = build(spec)
+    dp, cp = build(spec)
+    host = run_host_inject(dh, ch, 80, 2, inject=dict(inject))
+    serial = run_burst_shards(ds, cs, 80, 2, shards=0,
+                              inject=dict(inject))
+    shard = run_burst_shards(dp, cp, 80, 2, shards=8,
+                             inject=dict(inject))
+    assert len(serial) == len(shard)
+    assert_records_equal(serial, shard, "serial-vs-8shard-resident")
+    assert_records_equal(host[:len(shard)], shard,
+                         "host-vs-8shard-resident")
+    assert dh.admitted_keys() == ds.admitted_keys() == dp.admitted_keys()
+    st = dp._burst_solver.stats
+    assert st["burst_resident_hits"] >= 1, st
+    assert st["burst_resident_scatter_rows"] >= 1, st
+    # coalescing: never more ranges than rows, at least one range
+    assert 1 <= st["burst_resident_scatter_ranges"] \
+        <= st["burst_resident_scatter_rows"], st
+    # the residency must strictly reduce boundary host→device traffic
+    assert st["burst_boundary_bytes_h2d"] \
+        < st["burst_boundary_bytes_equiv"], st
+
+
+@needs_8_devices
+def test_burst_8shard_to_4_degradation_resident_parity(monkeypatch):
+    """8→4 mid-run degradation with the resident boundary on: the
+    resident copy is laid out for the dead mesh, so the next fresh pack
+    must re-gather from host over the 4 survivors — and every decision
+    before and after the loss stays bit-identical to serial and host."""
+    monkeypatch.setenv("KUEUE_TPU_RESIDENT_VERIFY", "1")
+    spec = sustained_spec()
+    dh, ch = build(spec)
+    ds, cs = build(spec)
+    dp, cp = build(spec)
+    # both burst arms restart scheduling at cycle 40 (runtime finishes
+    # don't cross a schedule_burst call), so the host control splits too
+    host = run_host(dh, ch, 40, 2) + run_host(dh, ch, 40, 2)
+    serial = (run_burst_shards(ds, cs, 40, 2, shards=0)
+              + run_burst_mode(ds, cs, 40, 2, pipeline=True))
+    first = run_burst_shards(dp, cp, 40, 2, shards=8)
+    bs = dp._burst_solver
+    assert bs.lose_devices(4) == 4
+    assert bs._resident is None
+    second = run_burst_mode(dp, cp, 40, 2, pipeline=True)
+    shard = first + second
+    assert len(serial) == len(shard)
+    assert_records_equal(serial, shard, "serial-vs-degraded")
+    assert_records_equal(host[:len(shard)], shard, "host-vs-degraded")
+    assert dh.admitted_keys() == ds.admitted_keys() == dp.admitted_keys()
+    st = bs.stats
+    assert st["burst_shard_degradations"] == 1, st
+    assert bs.n_shards == 4
+    # the post-loss windows really ran on the 4-shard mesh and the
+    # re-gather was a resident miss, not a stale-layout reuse
+    assert len(st["burst_shard_fetch_s"]) == 4
+    assert st["burst_resident_misses"] >= 1, st
+
+
+@needs_8_devices
+def test_burst_8shard_cost_rebalance_parity(monkeypatch):
+    """Cost-balanced forest partitioning: seeding the solver's cycle-
+    cost EWMA (as prior windows would) makes the next layout build use
+    measured cost for the LPT — and decisions stay bit-identical to the
+    count-based layout, because assignment never affects values."""
+    import numpy as np
+    monkeypatch.setenv("KUEUE_TPU_RESIDENT_VERIFY", "1")
+    wls = []
+    n = 0
+    for c in range(4):
+        for q in range(2):
+            for i in range(8):
+                n += 1
+                wls.append(mk(f"w-{c}-{q}-{i}", f"lq-{c}-{q}", 2000,
+                              prio=(i % 3) * 10, t=float(n)))
+    spec = add_workloads(
+        simple_cluster(n_cohorts=4, cqs=2, nominal=4000), wls)
+    ds, cs = build(spec)
+    dp, cp = build(spec)
+    serial = run_burst_shards(ds, cs, 60, 2, shards=0)
+
+    dpp, cpp = dp, cp
+    bs = BurstSolver(backend="cpu")
+    bs.set_shards(8)
+    dpp._burst_solver = bs
+    # measured-cost seed: as if prior windows decided heads only in
+    # forest 0 — a skewed EWMA the LPT must still spread deterministically
+    bs._forest_cost = {"generation": dpp.cache.structure_generation,
+                       "ewma": np.array([8.0, 1.0, 1.0, 1.0]),
+                       "windows": 5}
+    shard = run_burst_mode(dpp, cpp, 60, 2, pipeline=True)
+    assert len(serial) == len(shard)
+    assert_records_equal(serial, shard, "serial-vs-cost-balanced")
+    assert ds.admitted_keys() == dpp.admitted_keys()
+    st = bs.stats
+    assert st["burst_layout_cost_balanced"] >= 1, st
+    assert st["burst_shard_cost_ratio"] >= 1.0, st
+    assert len(st.get("burst_shard_cost", [])) == 8, st
+
+
 def test_burst_mesh_degrades_below_two_devices():
     """make_burst_mesh(1) is None and set_shards(1) keeps the serial
     path — graceful degradation on a 1-device mesh."""

@@ -139,3 +139,118 @@ def test_fs_parity_mesh1_vs_unsharded():
     assert ds.admitted_keys() == dm.admitted_keys()
     assert dm.scheduler.solver.stats["fs_full_cycles"] > 0
     assert dm.scheduler.solver.stats["sharded_fs_dispatches"] >= 1
+
+
+# ---------------------------------------------------------------------------
+# Shard-resident burst state (2-shard end of the contract; the 8-shard
+# end lives in test_multichip_parity.py)
+# ---------------------------------------------------------------------------
+
+def test_journal_coalesce_ranges():
+    """PackJournal.coalesce: adjacent and duplicate rows collapse into
+    [lo, hi) ranges — the unit contract under the one-transfer scatter."""
+    from kueue_tpu.utils.journal import PackJournal
+    assert PackJournal.coalesce([]) == []
+    assert PackJournal.coalesce([3]) == [(3, 4)]
+    assert PackJournal.coalesce([1, 2, 3, 7, 8, 12]) == [
+        (1, 4), (7, 9), (12, 13)]
+    assert PackJournal.coalesce([5, 5, 6, 6]) == [(5, 7)]
+
+
+def test_drain_into_reports_coalesced_ranges():
+    """drain_into with a row map coalesces the hard-dirty rows; the
+    merge/reset semantics are unchanged."""
+    from kueue_tpu.utils.journal import PackJournal
+    j = PackJournal()
+    j.dirty_all = False
+    for name in ("cq-1", "cq-2", "cq-3", "cq-9"):
+        j.touch(name)
+    j.note_roundtrip("cq-5", "k")
+    dirty, soft, ranges = set(), {}, []
+    was_all = j.drain_into(dirty, soft,
+                           row_of={f"cq-{i}": i for i in range(10)},
+                           ranges_out=ranges)
+    assert not was_all
+    assert dirty == {"cq-1", "cq-2", "cq-3", "cq-9"}
+    assert ranges == [(1, 4), (9, 10)]
+    assert soft == {"cq-5": {"k"}}
+    assert not j.dirty and not j.soft
+
+
+def test_burst_2shard_resident_multiwindow_parity(monkeypatch):
+    """Shard-resident reuse across windows on a 2-shard mesh: delta
+    packs scatter only dirty rows (solver-verified against a full
+    permute) and decisions stay bit-identical to serial and host."""
+    monkeypatch.setenv("KUEUE_TPU_RESIDENT_VERIFY", "1")
+    from test_burst import build, mk, run_host
+    from test_burst_pipeline import (
+        assert_records_equal, run_host_inject, sustained_spec)
+    from test_multichip_parity import run_burst_shards
+
+    spec = sustained_spec()
+    inject = {36: mk("boss", "lq-0-0", 4000, prio=100, t=500.0)}
+    dh, ch = build(spec)
+    ds, cs = build(spec)
+    dp, cp = build(spec)
+    host = run_host_inject(dh, ch, 80, 2, inject=dict(inject))
+    serial = run_burst_shards(ds, cs, 80, 2, shards=0,
+                              inject=dict(inject))
+    shard = run_burst_shards(dp, cp, 80, 2, shards=2,
+                             inject=dict(inject))
+    assert len(serial) == len(shard)
+    assert_records_equal(serial, shard, "serial-vs-2shard-resident")
+    assert_records_equal(host[:len(shard)], shard,
+                         "host-vs-2shard-resident")
+    st = dp._burst_solver.stats
+    assert st["burst_resident_hits"] >= 1, st
+    assert st["burst_boundary_bytes_h2d"] \
+        < st["burst_boundary_bytes_equiv"], st
+
+
+def test_burst_2shard_resident_kill_switch(monkeypatch):
+    """KUEUE_TPU_RESIDENT=0 keeps the pre-resident host-permute
+    boundary: no hits, no misses, decisions unchanged."""
+    monkeypatch.setenv("KUEUE_TPU_RESIDENT", "0")
+    from test_burst import build, mk
+    from test_burst_pipeline import assert_records_equal, sustained_spec
+    from test_multichip_parity import run_burst_shards
+
+    spec = sustained_spec(per_cq=20)
+    ds, cs = build(spec)
+    dp, cp = build(spec)
+    serial = run_burst_shards(ds, cs, 60, 2, shards=0)
+    shard = run_burst_shards(dp, cp, 60, 2, shards=2)
+    assert_records_equal(serial, shard, "serial-vs-2shard-nores")
+    st = dp._burst_solver.stats
+    assert st["burst_sharded_dispatches"] >= 1, st
+    assert st["burst_resident_hits"] == 0, st
+    assert st["burst_resident_misses"] == 0, st
+
+
+def test_refresh_layouts_rebalances_with_measured_cost(monkeypatch):
+    """refresh_layouts at a window seam: the EWMA measured during the
+    first segment feeds the rebuilt layout's LPT, the resident copy is
+    re-gathered, and decisions stay bit-identical throughout."""
+    monkeypatch.setenv("KUEUE_TPU_RESIDENT_VERIFY", "1")
+    from test_burst import build, run_host
+    from test_burst_pipeline import (
+        assert_records_equal, run_burst_mode, sustained_spec)
+    from test_multichip_parity import run_burst_shards
+
+    spec = sustained_spec()
+    ds, cs = build(spec)
+    dp, cp = build(spec)
+    serial = (run_burst_shards(ds, cs, 40, 2, shards=0)
+              + run_burst_mode(ds, cs, 40, 2, pipeline=True))
+    first = run_burst_shards(dp, cp, 40, 2, shards=2)
+    bs = dp._burst_solver
+    assert bs._forest_cost is not None and bs._forest_cost["windows"] >= 1
+    bs.refresh_layouts()
+    assert bs._resident is None
+    second = run_burst_mode(dp, cp, 40, 2, pipeline=True)
+    shard = first + second
+    assert len(serial) == len(shard)
+    assert_records_equal(serial, shard, "serial-vs-rebalanced")
+    st = bs.stats
+    assert st["burst_layout_rebuilds"] >= 2, st
+    assert st["burst_layout_cost_balanced"] >= 1, st
