@@ -9,6 +9,7 @@ snapshots (snapshot.py).
 
 from __future__ import annotations
 
+import os
 import threading
 from typing import Optional
 
@@ -29,11 +30,40 @@ from .snapshot import Snapshot
 from .state import (
     CohortState,
     CQState,
+    SnapTag,
     build_quotas,
     update_cluster_queue_resource_node,
     update_cohort_resource_node,
 )
 from .tas_cache import TASCache
+
+
+class _SnapCache:
+    """Clone forest retained between snapshots for incremental reuse.
+
+    Valid for exactly one ``structure_generation``: spec-level edits
+    (CQ/cohort/flavor/check churn, activeness recompute) bump the
+    generation and force a full rebuild, so the cache only has to track
+    *usage*-level dirt.  A cached root tree is reused verbatim when
+    (a) the live side didn't touch any of its CQs since the last drain
+    (PackJournal ``snap_dirty`` channel) and (b) no snapshot consumer
+    scribbled on the clone (SnapTag)."""
+
+    __slots__ = ("generation", "root_order", "root_clones", "root_tags",
+                 "free_clones", "free_tags", "tree_of_cq", "cq_map",
+                 "inactive", "flavors")
+
+    def __init__(self, generation: int):
+        self.generation = generation
+        self.root_order: list[str] = []            # roots() build order
+        self.root_clones: dict[str, CohortState] = {}
+        self.root_tags: dict[str, SnapTag] = {}
+        self.free_clones: dict[str, CQState] = {}  # cohortless CQs
+        self.free_tags: dict[str, SnapTag] = {}
+        self.tree_of_cq: dict[str, str] = {}       # cq name → root name
+        self.cq_map: dict[str, CQState] = {}
+        self.inactive: set[str] = set()
+        self.flavors: dict[str, ResourceFlavor] = {}
 
 
 class Cache:
@@ -60,6 +90,20 @@ class Cache:
         # structure_generation, which forces a full repack by key
         from ..utils.journal import PackJournal
         self.pack_journal = PackJournal()
+        # Incremental snapshot maintenance: per-cycle snapshot cost is
+        # O(arrivals + dirty rows), not O(universe).  The clone forest
+        # is retained across cycles and only journal-dirty or
+        # consumer-mutated trees are re-cloned.  KUEUE_TPU_SNAP_INCREMENTAL=0
+        # restores the old full-rebuild-every-cycle behavior (used by
+        # the parity tests).
+        self._snap_cache: Optional[_SnapCache] = None
+        self._snap_incremental = os.environ.get(
+            "KUEUE_TPU_SNAP_INCREMENTAL", "1").lower() not in ("0", "false")
+        self.snapshot_stats: dict[str, int] = {
+            "snap_builds": 0, "snap_full": 0, "snap_incremental": 0,
+            "snap_trees_recloned": 0, "snap_trees_reused": 0,
+            "snap_cqs_recloned": 0, "snap_cqs_reused": 0,
+        }
 
     # ------------------------------------------------------------------
     # ClusterQueues / Cohorts
@@ -277,25 +321,107 @@ class Cache:
     # ------------------------------------------------------------------
 
     def snapshot(self) -> Snapshot:
+        """Per-cycle snapshot.  Incremental: the clone forest from the
+        previous snapshot is reused wholesale for every root tree whose
+        CQs were neither touched on the live side (PackJournal snapshot
+        channel) nor mutated on the clone side (SnapTag) — only dirty
+        trees pay the re-clone.  A snapshot is valid until the next
+        ``snapshot()`` call (the scheduler's within-cycle use), same as
+        the previous full-rebuild contract which already shared Info
+        objects with the live store."""
         with self._lock:
-            cq_map: dict[str, CQState] = {}
-            roots = []
-            for node in self._mgr.roots():
-                roots.append(node.payload.clone_subtree(None, cq_map))
-            for name, cq in self._mgr.cluster_queues.items():
-                if name not in cq_map:  # cohortless CQ
-                    cq_map[name] = cq.clone(parent=None)
-            inactive = {name for name, cq in self._mgr.cluster_queues.items()
-                        if not cq.active}
+            gen = self.structure_generation
+            sc = self._snap_cache
+            dirty, was_all = self.pack_journal.drain_snapshot()
+            if (not self._snap_incremental or sc is None
+                    or sc.generation != gen or was_all):
+                sc = self._snapshot_full(gen)
+            else:
+                self._snapshot_refresh(sc, dirty)
+            self.snapshot_stats["snap_builds"] += 1
             return Snapshot(
-                cluster_queues=cq_map,
-                roots=roots,
-                inactive_cluster_queues=inactive,
-                resource_flavors=dict(self.resource_flavors),
+                cluster_queues=dict(sc.cq_map),
+                roots=[sc.root_clones[r] for r in sc.root_order],
+                inactive_cluster_queues=set(sc.inactive),
+                resource_flavors=dict(sc.flavors),
                 tas_flavors=self.tas.snapshot(),
                 fair_sharing_enabled=self.fair_sharing_enabled,
-                structure_generation=self.structure_generation,
+                structure_generation=gen,
             )
+
+    def _snapshot_full(self, gen: int) -> _SnapCache:
+        sc = _SnapCache(gen)
+        for node in self._mgr.roots():
+            self._snap_clone_root(sc, node)
+        for name, cq in self._mgr.cluster_queues.items():
+            if name not in sc.cq_map:  # cohortless CQ
+                self._snap_clone_free(sc, name, cq)
+        sc.inactive = {name for name, cq in self._mgr.cluster_queues.items()
+                       if not cq.active}
+        sc.flavors = dict(self.resource_flavors)
+        self._snap_cache = sc
+        self.snapshot_stats["snap_full"] += 1
+        return sc
+
+    def _snapshot_refresh(self, sc: _SnapCache, dirty: set) -> None:
+        dirty_roots: set[str] = set()
+        dirty_free: set[str] = set()
+        for name in dirty:
+            root = sc.tree_of_cq.get(name)
+            if root is not None:
+                dirty_roots.add(root)
+            elif name in sc.free_clones:
+                dirty_free.add(name)
+            # else: touch for a CQ unknown at this generation — any
+            # add/delete that could explain it bumped the generation
+        for rname, tag in sc.root_tags.items():
+            if tag.mutated:
+                dirty_roots.add(rname)
+        for name, tag in sc.free_tags.items():
+            if tag.mutated:
+                dirty_free.add(name)
+        st = self.snapshot_stats
+        recloned_before = st["snap_cqs_recloned"]
+        for rname in dirty_roots:
+            node = self._mgr.cohorts.get(rname)
+            if node is not None:
+                # same generation → same membership: the re-clone
+                # overwrites exactly the stale cq_map/tree_of_cq entries
+                self._snap_clone_root(sc, node)
+        for name in dirty_free:
+            cq = self._mgr.cluster_queues.get(name)
+            if cq is not None:
+                self._snap_clone_free(sc, name, cq)
+        st["snap_incremental"] += 1
+        st["snap_trees_reused"] += len(sc.root_clones) - len(dirty_roots)
+        st["snap_cqs_reused"] += (
+            len(sc.cq_map) - (st["snap_cqs_recloned"] - recloned_before))
+
+    def _snap_clone_root(self, sc: _SnapCache, node) -> None:
+        sub: dict[str, CQState] = {}
+        clone = node.payload.clone_subtree(None, sub)
+        tag = SnapTag()
+        for cq in sub.values():
+            cq._snap_tag = tag
+        name = node.name
+        if name not in sc.root_clones:
+            sc.root_order.append(name)
+        sc.root_clones[name] = clone
+        sc.root_tags[name] = tag
+        for cq_name in sub:
+            sc.tree_of_cq[cq_name] = name
+        sc.cq_map.update(sub)
+        self.snapshot_stats["snap_trees_recloned"] += 1
+        self.snapshot_stats["snap_cqs_recloned"] += len(sub)
+
+    def _snap_clone_free(self, sc: _SnapCache, name: str, cq: CQState) -> None:
+        c = cq.clone(parent=None)
+        tag = SnapTag()
+        c._snap_tag = tag
+        sc.free_clones[name] = c
+        sc.free_tags[name] = tag
+        sc.cq_map[name] = c
+        self.snapshot_stats["snap_cqs_recloned"] += 1
 
     # ------------------------------------------------------------------
     # Status / reporting

@@ -27,6 +27,24 @@ from . import resource_node as rn
 MAX_DRS = sys.maxsize  # weight-zero sentinel (reference fair_sharing.go:52)
 
 
+class SnapTag:
+    """Per-root-tree mutation flag attached to *snapshot clones*.
+
+    The incremental snapshot builder (cache.Cache.snapshot) hands out
+    cached clone trees across cycles; a cached tree is only reusable if
+    the scheduler didn't scribble on it (preemption simulation,
+    in-cycle capacity reservation).  Every CQ clone in a cached tree
+    shares one tag; the usage mutators flip it, and the builder
+    re-clones flipped trees from the live cache.  Live CQStates carry
+    ``_snap_tag = None`` so the hot-path cost on the live side is one
+    attribute test."""
+
+    __slots__ = ("mutated",)
+
+    def __init__(self):
+        self.mutated = False
+
+
 def build_quotas(resource_groups) -> dict[FlavorResource, ResourceQuota]:
     """Flatten resource groups into the (flavor, resource) → quota map.
 
@@ -104,6 +122,7 @@ class CQState:
         self.inactive_reasons: list[str] = []
         self.fair_weight_milli = int((spec.fair_sharing.weight if spec.fair_sharing else 1.0) * 1000)
         self.admitted_usage = FlavorResourceQuantities()  # Admitted (vs merely reserving)
+        self._snap_tag: Optional[SnapTag] = None
         self.update_quotas(spec)
 
     # -- identity / config passthroughs --
@@ -144,6 +163,9 @@ class CQState:
         clusterqueue.go addWorkload errors on an already-present key)."""
         if info.key in self.workloads:
             return False
+        tag = self._snap_tag
+        if tag is not None:
+            tag.mutated = True
         self.workloads[info.key] = info
         rn.apply_usage(self, info.usage(), +1)
         if info.obj.is_admitted:
@@ -153,6 +175,9 @@ class CQState:
     def remove_workload(self, info: Info) -> None:
         if self.workloads.pop(info.key, None) is None:
             return
+        tag = self._snap_tag
+        if tag is not None:
+            tag.mutated = True
         rn.apply_usage(self, info.usage(), -1)
         if info.obj.is_admitted:
             self.admitted_usage.sub(info.usage())
@@ -174,10 +199,16 @@ class CQState:
     def simulate_usage_addition(self, usage: FlavorResourceQuantities):
         """Apply usage, returning a revert closure (reference
         clusterqueue_snapshot.go SimulateUsageAddition)."""
+        tag = self._snap_tag
+        if tag is not None:
+            tag.mutated = True
         rn.apply_usage(self, usage, +1)
         return lambda: rn.apply_usage(self, usage, -1)
 
     def simulate_usage_removal(self, usage: FlavorResourceQuantities):
+        tag = self._snap_tag
+        if tag is not None:
+            tag.mutated = True
         rn.apply_usage(self, usage, -1)
         return lambda: rn.apply_usage(self, usage, +1)
 
@@ -201,6 +232,7 @@ class CQState:
         c.inactive_reasons = list(self.inactive_reasons)
         c.fair_weight_milli = self.fair_weight_milli
         c.admitted_usage = self.admitted_usage.clone()
+        c._snap_tag = None
         return c
 
     # -- fair sharing (reference pkg/cache/fair_sharing.go:47) --

@@ -1253,6 +1253,34 @@ class Driver:
 
     # -- introspection --
 
+    @property
+    def stats(self) -> dict:
+        """One-stop counter snapshot shared by the perf harness, the
+        chaos report, and the open-loop traffic runner: incremental
+        snapshot reuse, queue depth / requeue-storm accounting, and the
+        burst solver's dispatch counters when one is live."""
+        q = self.queues
+        out = {
+            "snapshot": dict(self.cache.snapshot_stats),
+            "queue": {
+                "ready_cqs": len(q._ready),
+                "armed_timer_cqs": len(q._timers),
+                "requeue_storm_last": q.requeue_storm_last,
+                "requeue_storm_peak": q.requeue_storm_peak,
+                "requeue_storms_total": q.requeue_storms_total,
+                "requeue_unparked_total": q.requeue_unparked_total,
+            },
+            "admission_attempts": {
+                "success": int(self.metrics.counters.get(
+                    ("kueue_admission_attempts_total", "success"), 0)),
+                "inadmissible": int(self.metrics.counters.get(
+                    ("kueue_admission_attempts_total", "inadmissible"), 0)),
+            },
+        }
+        if self._burst_solver is not None:
+            out["burst"] = dict(self._burst_solver.stats)
+        return out
+
     def admitted_keys(self) -> set[str]:
         """Workloads currently holding quota (reserved and not finished)."""
         return {k for k, wl in self.workloads.items()

@@ -22,6 +22,10 @@ def exponential_buckets(start: float, factor: float, count: int) -> list[float]:
 
 ATTEMPT_BUCKETS = exponential_buckets(0.001, 2, 16)  # seconds
 WAIT_BUCKETS = exponential_buckets(1, 2, 14)
+# open-loop admission latency (submit→admit, virtual seconds) and
+# requeue-storm sizes (workloads unparked per cohort wakeup)
+LATENCY_BUCKETS = exponential_buckets(0.25, 2, 18)
+STORM_BUCKETS = exponential_buckets(1, 2, 16)
 
 
 @dataclass
@@ -168,6 +172,37 @@ class Registry:
         self.set_gauge("kueue_local_queue_admitted_active_workloads",
                        (namespace, lq), admitted)
 
+    # -- open-loop traffic series (traffic/runner.py; also read back by
+    #    Driver.stats so the soak harness and the chaos report share one
+    #    source) --
+
+    def open_loop_sample(self, depth_active: int, depth_parked: int,
+                         age_p50_s: float, age_p99_s: float,
+                         admissions_per_s: float) -> None:
+        """Per-sample open-loop gauges: queue depth by status, pending
+        age quantiles, and the achieved admissions/s rate."""
+        self.set_gauge("kueue_open_loop_queue_depth", ("active",),
+                       depth_active)
+        self.set_gauge("kueue_open_loop_queue_depth", ("inadmissible",),
+                       depth_parked)
+        self.set_gauge("kueue_open_loop_pending_age_seconds", ("p50",),
+                       age_p50_s)
+        self.set_gauge("kueue_open_loop_pending_age_seconds", ("p99",),
+                       age_p99_s)
+        self.set_gauge("kueue_open_loop_admissions_per_second", (),
+                       admissions_per_s)
+
+    def open_loop_latency(self, latency_s: float) -> None:
+        self.observe("kueue_open_loop_admission_latency_seconds", (),
+                     latency_s, LATENCY_BUCKETS)
+
+    def open_loop_requeue_storm(self, size: int) -> None:
+        self.observe("kueue_open_loop_requeue_storm_size", (), size,
+                     STORM_BUCKETS)
+        cur = self.gauges.get(("kueue_open_loop_requeue_storm_peak",), 0.0)
+        self.set_gauge("kueue_open_loop_requeue_storm_peak", (),
+                       max(cur, size))
+
     def report_weighted_share(self, cq: str, share: float) -> None:
         self.set_gauge("kueue_cluster_queue_weighted_share", (cq,), share)
 
@@ -223,6 +258,12 @@ LABEL_NAMES = {
         ("namespace", "local_queue"),
     "kueue_local_queue_admitted_active_workloads":
         ("namespace", "local_queue"),
+    "kueue_open_loop_queue_depth": ("status",),
+    "kueue_open_loop_pending_age_seconds": ("quantile",),
+    "kueue_open_loop_admissions_per_second": (),
+    "kueue_open_loop_admission_latency_seconds": (),
+    "kueue_open_loop_requeue_storm_size": (),
+    "kueue_open_loop_requeue_storm_peak": (),
 }
 
 

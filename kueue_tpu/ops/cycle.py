@@ -518,11 +518,14 @@ def _forest_schedule(order, f_w, W, G, max_forest_wl):
         jnp.arange(W, dtype=jnp.int32))
     p = jnp.lexsort((inv_order, f_w))                    # [W]
     f_sorted = f_w[p]
-    first = jnp.concatenate([jnp.array([True]),
-                             f_sorted[1:] != f_sorted[:-1]])
     pos = jnp.arange(W)
-    seg_start = jax.lax.associative_scan(
-        jnp.maximum, jnp.where(first, pos, 0))
+    # each segment's start = index of the first element with its forest
+    # id; searchsorted on the sorted ids gives it directly.  NOT a
+    # prefix max over flagged starts: lax.associative_scan miscomputes
+    # under GSPMD sharding (observed on the (wl, cq) production mesh —
+    # positions read partial maxima from other shards' blocks), and
+    # sort-family ops gather correctly where the scan lowering does not
+    seg_start = jnp.searchsorted(f_sorted, f_sorted, side="left")
     rank = (pos - seg_start).astype(jnp.int32)           # in-forest rank
     mat = jnp.full((G, max_forest_wl), -1, dtype=jnp.int32)
     # ranks beyond max_forest_wl are dropped (host sizes the bucket)

@@ -338,6 +338,33 @@ def chaos_report(injector=None, bstats: dict | None = None,
     return out
 
 
+class MissingControlArm(ValueError):
+    """An A/B block was requested without an interleaved control arm."""
+
+
+def ab_block(treatment: dict, control: dict | None, *,
+             treatment_label: str = "treatment",
+             control_label: str = "control") -> dict:
+    """Environment-drift bookkeeping for published artifacts: every A/B
+    comparison must carry its own same-box control, measured
+    *interleaved* with the treatment (control, treatment, control, …)
+    so thermal/noisy-neighbor drift shows up as control variance
+    instead of silently biasing the delta.  Refuses to build the block
+    otherwise — a treatment-only number is not publishable."""
+    if not control:
+        raise MissingControlArm(
+            "refusing to emit an A/B block without a control arm — "
+            "measure an interleaved same-box control alongside the "
+            "treatment")
+    if not control.get("interleaved"):
+        raise MissingControlArm(
+            "control arm is not marked interleaved=True — a control "
+            "measured before/after the treatment (not interleaved with "
+            "it) does not bound environment drift")
+    return {treatment_label: dict(treatment),
+            control_label: dict(control)}
+
+
 def check_rangespec(stats: PerfStats, rangespec: dict) -> list[str]:
     """reference test/performance/scheduler checker semantics."""
     failures = []

@@ -54,11 +54,32 @@ class ClusterQueueQueue:
         # mutators that can change this CQ's packed burst rows mark it
         # dirty; pop/requeue roundtrips only soft-mark (utils/journal.py)
         self.journal = None
+        # Manager-shared index sets (set on registration).  ``ready``
+        # holds CQ names whose heap may be non-empty, so per-cycle head
+        # collection is O(ready) instead of O(all CQs); ``timers`` holds
+        # CQ names with at least one parked entry carrying a live
+        # requeue_at, so backoff wakeups scan only armed queues.  Both
+        # are conservative over-approximations maintained lazily.
+        self.ready = None
+        self.timers = None
 
     def _touch(self) -> None:
         j = self.journal
         if j is not None:
             j.touch(self.name)
+
+    def _mark_ready(self) -> None:
+        r = self.ready
+        if r is not None:
+            r.add(self.name)
+
+    def _note_timer(self, info: Info) -> None:
+        t = self.timers
+        if t is None:
+            return
+        rs = info.obj.requeue_state
+        if rs is not None and rs.requeue_at is not None:
+            t.add(self.name)
 
     # ------------------------------------------------------------------
 
@@ -91,11 +112,14 @@ class ClusterQueueQueue:
                     and old.obj.conditions.get(WL_REQUEUED) == info.obj.conditions.get(WL_REQUEUED))
             if same:
                 self.inadmissible[key] = info
+                self._note_timer(info)
                 return
         if self.heap.get(key) is None and not self.backoff_waiting_time_expired(info):
             self.inadmissible[key] = info
+            self._note_timer(info)
             return
         self.heap.push_or_update(info)
+        self._mark_ready()
 
     def delete(self, key: str) -> None:
         parked = self.inadmissible.pop(key, None)
@@ -132,6 +156,7 @@ class ClusterQueueQueue:
             if parked is not None:
                 info = parked
             pushed = self.heap.push_if_not_present(info)
+            self._mark_ready()
             if parked is not None or (pushed and not was_inflight):
                 # unpark or external (re)arrival: packed rows changed
                 self._touch()
@@ -149,16 +174,19 @@ class ClusterQueueQueue:
                 j.note_roundtrip(self.name, key)
             return False
         self.inadmissible[key] = info
+        self._note_timer(info)
         self._touch()
         return True
 
-    def wake_expired_backoffs(self) -> bool:
+    def wake_expired_backoffs(self) -> int:
         """Unpark workloads whose requeue backoff just expired — the
         in-process stand-in for the reference's RequeueAfter timers
         (workload_controller.go requeues when the backoff fires).  The
         consumed requeue_at is cleared so the workload isn't re-woken
-        every tick if it parks again."""
-        moved = False
+        every tick if it parks again.  Returns the number of workloads
+        moved to the heap (0 = nothing moved, truth-compatible with the
+        old bool)."""
+        moved = 0
         still: dict[str, Info] = {}
         before = len(self.inadmissible)
         for key, info in self.inadmissible.items():
@@ -170,23 +198,40 @@ class ClusterQueueQueue:
                 # (mirrors queue_inadmissible_workloads: never track an
                 # entry in both structures)
                 if self.heap.push_if_not_present(info):
-                    moved = True
+                    moved += 1
                 continue
             still[key] = info
         self.inadmissible = still
         if moved or len(still) != before:
             # a cleared requeue_at flips the row from pack-excluded to
-            # packed even when the heap already held it (moved False)
+            # packed even when the heap already held it (moved 0)
             self._touch()
+            self._mark_ready()
+        self._retime()
         return moved
 
-    def queue_inadmissible_workloads(self) -> bool:
+    def _retime(self) -> None:
+        """Recompute membership in the shared timer set from the parked
+        entries that still carry a live requeue_at."""
+        t = self.timers
+        if t is None:
+            return
+        for info in self.inadmissible.values():
+            rs = info.obj.requeue_state
+            if rs is not None and rs.requeue_at is not None:
+                t.add(self.name)
+                return
+        t.discard(self.name)
+
+    def queue_inadmissible_workloads(self) -> int:
         """Move the parking lot back into the heap (reference
-        cluster_queue.go QueueInadmissibleWorkloads)."""
+        cluster_queue.go QueueInadmissibleWorkloads).  Returns the
+        number of workloads moved (0 = nothing, truth-compatible with
+        the old bool)."""
         self.queue_inadmissible_cycle = self.pop_cycle
         if not self.inadmissible:
-            return False
-        moved = False
+            return 0
+        moved = 0
         still_waiting: dict[str, Info] = {}
         before = len(self.inadmissible)
         for key, info in self.inadmissible.items():
@@ -194,10 +239,13 @@ class ClusterQueueQueue:
                 still_waiting[key] = info
                 continue
             if self.heap.push_if_not_present(info):
-                moved = True
+                moved += 1
         self.inadmissible = still_waiting
         if moved or len(still_waiting) != before:
             self._touch()
+        if moved:
+            self._mark_ready()
+        self._retime()
         return moved
 
     def pop(self) -> Optional[Info]:

@@ -44,6 +44,21 @@ class Manager:
         # dirty-CQ journal feeding the incremental burst pack; every
         # registered ClusterQueueQueue shares it (utils/journal.py)
         self.pack_journal = PackJournal()
+        # O(active) indices shared with every registered queue: names
+        # whose heap may hold entries (head collection iterates these in
+        # registration order, matching the old full-dict scan), and
+        # names with an armed requeue-backoff timer (wakeup scans these
+        # only).  Conservative: stale names are dropped lazily.
+        self._ready: set[str] = set()
+        self._timers: set[str] = set()
+        self._reg_seq: dict[str, int] = {}
+        self._next_seq = 0
+        # requeue-storm accounting (cohort-wide unpark bursts), surfaced
+        # through Driver.stats and the open-loop traffic metrics
+        self.requeue_storm_last = 0
+        self.requeue_storm_peak = 0
+        self.requeue_storms_total = 0
+        self.requeue_unparked_total = 0
 
     # ------------------------------------------------------------------
     # ClusterQueues / LocalQueues / Cohorts
@@ -60,6 +75,11 @@ class Manager:
                                   self.ordering, self.clock)
             q.active = spec.stop_policy == StopPolicy.NONE
             q.journal = self.pack_journal
+            q.ready = self._ready
+            q.timers = self._timers
+            self._next_seq += 1
+            self._reg_seq[spec.name] = self._next_seq
+            self._ready.add(spec.name)
             self.pack_journal.touch(spec.name)
             self._mgr.add_cluster_queue(spec.name, q)
             self._mgr.update_cluster_queue_edge(spec.name, spec.cohort)
@@ -76,12 +96,16 @@ class Manager:
             self.pack_journal.touch(spec.name)
             self._mgr.update_cluster_queue_edge(spec.name, spec.cohort)
             if q.active:
+                self._ready.add(spec.name)
                 q.queue_inadmissible_workloads()
             self._cond.notify_all()
 
     def delete_cluster_queue(self, name: str) -> None:
         with self._lock:
             self.pack_journal.touch(name)
+            self._ready.discard(name)
+            self._timers.discard(name)
+            self._reg_seq.pop(name, None)
             self._mgr.delete_cluster_queue(name)
 
     def set_cluster_queue_active(self, name: str, active: bool) -> None:
@@ -92,6 +116,8 @@ class Manager:
             self.pack_journal.touch(name)
             q.active = active
             if active:
+                # reactivation makes any existing heap poppable again
+                self._ready.add(name)
                 q.queue_inadmissible_workloads()
             self._cond.notify_all()
 
@@ -208,12 +234,16 @@ class Manager:
                 if parent is not None:
                     for cq_name in (q.name for q in parent.root().subtree_cqs()):
                         names.add(cq_name)
-            moved = False
+            moved = 0
             for name in names:
                 q = self._mgr.cluster_queues.get(name)
-                if q is not None and q.queue_inadmissible_workloads():
-                    moved = True
+                if q is not None:
+                    moved += q.queue_inadmissible_workloads()
             if moved:
+                self.requeue_storm_last = moved
+                self.requeue_storm_peak = max(self.requeue_storm_peak, moved)
+                self.requeue_storms_total += 1
+                self.requeue_unparked_total += moved
                 self._cond.notify_all()
 
     def broadcast(self) -> None:
@@ -222,13 +252,19 @@ class Manager:
 
     def wake_expired_backoffs(self) -> None:
         """RequeueAfter-timer equivalent: unpark workloads whose requeue
-        backoff expired (called per cycle and on daemon ticks)."""
+        backoff expired (called per cycle and on daemon ticks).  Scans
+        only queues in the armed-timer set — O(armed), not O(all CQs);
+        each queue recomputes its own membership after the wake."""
         with self._lock:
-            moved = False
-            for q in self._mgr.cluster_queues.values():
-                if q.wake_expired_backoffs():
-                    moved = True
+            moved = 0
+            for name in list(self._timers):
+                q = self._mgr.cluster_queues.get(name)
+                if q is None:
+                    self._timers.discard(name)
+                    continue
+                moved += q.wake_expired_backoffs()
             if moved:
+                self.requeue_unparked_total += moved
                 self._cond.notify_all()
 
     # ------------------------------------------------------------------
@@ -267,13 +303,29 @@ class Manager:
             self._cond.notify_all()
 
     def _collect_heads(self) -> list[Info]:
+        """One head per active CQ with pending entries, O(ready).
+        Registration-sequence iteration reproduces the old full-dict
+        insertion-order scan exactly (dict insertion order == first-add
+        order; deletes + re-adds get a fresh, higher sequence, matching
+        the dict's end-append)."""
         out = []
-        for q in self._mgr.cluster_queues.values():
-            if not q.active:
+        ready = self._ready
+        if not ready:
+            return out
+        seq = self._reg_seq
+        cqs = self._mgr.cluster_queues
+        for name in sorted(ready, key=lambda n: seq.get(n, 0)):
+            q = cqs.get(name)
+            if q is None:
+                ready.discard(name)
                 continue
+            if not q.active:
+                continue   # stays ready: reactivation resumes popping
             info = q.pop()
             if info is not None:
                 out.append(info)
+            if not len(q.heap):
+                ready.discard(name)   # lazy removal; pushes re-mark
         return out
 
     # ------------------------------------------------------------------

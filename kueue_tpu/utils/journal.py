@@ -21,6 +21,12 @@ Two dirt grades keep the hot path clean:
 (e.g. LimitRange summaries).  A fresh journal starts dirty-all so the
 first pack is always a full walk.
 
+The journal also feeds a second, independent consumer: the cache's
+incremental snapshot builder reads its own ``snap_dirty``/``snap_all``
+channel via ``drain_snapshot`` so the burst pack's destructive
+``drain_into`` and the snapshot's per-cycle drain never race for the
+same dirt.
+
 ``CycleWAL`` is the durable sibling: a write-ahead log of the driver's
 per-cycle decision batches (admits, evictions, requeue-state updates,
 finishes).  Every op is journaled *before* the store mutation it
@@ -49,7 +55,8 @@ from ..chaos import injector as _chaos
 
 
 class PackJournal:
-    __slots__ = ("dirty", "dirty_all", "soft", "tainted")
+    __slots__ = ("dirty", "dirty_all", "soft", "tainted",
+                 "snap_dirty", "snap_all")
 
     def __init__(self):
         self.dirty: set[str] = set()
@@ -59,18 +66,42 @@ class PackJournal:
         # journal; the next drain reports dirty-all so the pack falls
         # back to a full walk instead of trusting incomplete dirt
         self.tainted = False
+        # Second consumer channel: the incremental snapshot builder
+        # (cache.Cache.snapshot).  The burst pack's drain_into is
+        # destructive, so the snapshot keeps its own dirt accumulator,
+        # fed by the same mutators and drained independently.  A lost
+        # update (drop_touch) poisons this channel immediately — unlike
+        # ``tainted`` it cannot wait for the next burst drain, because
+        # the two consumers drain at different times.
+        self.snap_dirty: set[str] = set()
+        self.snap_all = True
 
     def touch(self, cq_name: str) -> None:
         if _chaos.ACTIVE is not None:
             if _chaos.ACTIVE.hit("journal.drop_touch") is not None:
                 self.tainted = True
+                self.snap_all = True
                 return
             if _chaos.ACTIVE.hit("journal.spurious_dirty_all") is not None:
                 self.dirty_all = True
+                self.snap_all = True
         self.dirty.add(cq_name)
+        self.snap_dirty.add(cq_name)
 
     def touch_all(self) -> None:
         self.dirty_all = True
+        self.snap_all = True
+
+    def drain_snapshot(self) -> tuple[set, bool]:
+        """Drain the snapshot consumer's channel: returns
+        ``(dirty_cq_names, was_all)`` and resets only this channel —
+        the burst pack's ``dirty``/``soft``/``dirty_all`` state is
+        untouched, and vice versa for :meth:`drain_into`."""
+        was_all = self.snap_all
+        out = self.snap_dirty
+        self.snap_dirty = set()
+        self.snap_all = False
+        return out, was_all
 
     def note_roundtrip(self, cq_name: str, key: str) -> None:
         s = self.soft.get(cq_name)

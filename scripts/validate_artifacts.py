@@ -17,6 +17,12 @@ unreadable number.  Checks are tiered:
                      with a ``reason``), plus ``all_stable`` /
                      ``scenarios_total`` / ``scenarios_stable``
                      consistent with the per-scenario verdicts.
+  TRAFFIC_*        — additionally: the SLO + arrival-process params,
+                     per-arm ``sustainable_rate_per_s`` with a
+                     per-rate latency ``curve`` (histograms included),
+                     an ``interleaved`` control arm, a bool
+                     ``replay_identical``, and the
+                     ``snapshot_counters`` host-cost block.
   NORTHSTAR_* /
   MULTICHIP_r08+   — additionally: ``metric`` + numeric ``value``.
   MULTICHIP_r10+   — additionally: at least one ``crossover`` block
@@ -174,10 +180,69 @@ def _check_crossover(label, c, path, out):
              "'decisions_identical_across_arms'")
 
 
+def _check_traffic(d, path, out):
+    """TRAFFIC_* open-loop artifacts (scripts/traffic_soak.py): the
+    arrival-process parameters, the SLO, per-arm sustainable-rate
+    results with per-rate latency curves (histograms included), the
+    interleaved same-box control arm, the replay verdict, and the
+    incremental-snapshot host-cost counters."""
+    slo = d.get("slo")
+    if not isinstance(slo, dict) \
+            or not isinstance(slo.get("p99_latency_s"), (int, float)):
+        _err(out, path, "'slo' must carry numeric 'p99_latency_s'")
+    arrival = d.get("arrival")
+    if not isinstance(arrival, dict):
+        _err(out, path, "'arrival' must be an object")
+    else:
+        if not isinstance(arrival.get("process"), str):
+            _err(out, path, "'arrival.process' must be a string")
+        if not isinstance(arrival.get("seed"), int):
+            _err(out, path, "'arrival.seed' must be an int")
+    arms = d.get("arms")
+    if not isinstance(arms, dict) or not arms:
+        _err(out, path, "'arms' must be a non-empty object")
+    else:
+        for name, a in arms.items():
+            if not isinstance(a, dict):
+                _err(out, path, f"arm '{name}' must be an object")
+                continue
+            if not isinstance(a.get("sustainable_rate_per_s"),
+                              (int, float)):
+                _err(out, path, f"arm '{name}' missing numeric "
+                     "'sustainable_rate_per_s'")
+            curve = a.get("curve")
+            if not isinstance(curve, list) or len(curve) < 2:
+                _err(out, path, f"arm '{name}' needs a 'curve' list "
+                     "with >= 2 rates")
+                continue
+            for e in curve:
+                if not isinstance(e, dict):
+                    _err(out, path, f"arm '{name}' curve entries must "
+                         "be objects")
+                    break
+                for k in ("rate_per_s", "p50_latency_s",
+                          "p99_latency_s", "admissions_per_s"):
+                    if not isinstance(e.get(k), (int, float)):
+                        _err(out, path, f"arm '{name}' curve entry "
+                             f"missing numeric '{k}'")
+                if not isinstance(e.get("latency_hist"), list):
+                    _err(out, path, f"arm '{name}' curve entry missing "
+                         "'latency_hist' list")
+    control = d.get("control")
+    if not isinstance(control, dict) \
+            or control.get("interleaved") is not True:
+        _err(out, path, "'control' must be an object with "
+             "interleaved=true (same-box environment-drift arm)")
+    if not isinstance(d.get("replay_identical"), bool):
+        _err(out, path, "missing bool 'replay_identical'")
+    if not isinstance(d.get("snapshot_counters"), dict):
+        _err(out, path, "missing 'snapshot_counters' object")
+
+
 # generator scripts that postdate the schema convention (metric+value
 # at top level); older BENCH_/MULTICHIP_r01-05 wrappers predate it and
 # only get the common checks
-_STRICT_PREFIXES = ("NORTHSTAR_", "CHAOS_")
+_STRICT_PREFIXES = ("NORTHSTAR_", "CHAOS_", "TRAFFIC_")
 
 
 def validate(path: str) -> list[str]:
@@ -194,6 +259,10 @@ def validate(path: str) -> list[str]:
     # if the file was renamed
     if base.startswith("CHAOS_") or "scenarios" in d:
         _check_chaos(d, path, out)
+    # by name or by shape: a per-arm saturation table is a traffic
+    # artifact even if the file was renamed
+    if base.startswith("TRAFFIC_") or "arms" in d:
+        _check_traffic(d, path, out)
     m = re.match(r"MULTICHIP_R(\d+)", base)
     if base.startswith(_STRICT_PREFIXES) or (m and int(m.group(1)) >= 8):
         _check_metric_value(d, path, out)

@@ -14,6 +14,12 @@ import jax  # noqa: E402
 jax.config.update("jax_platforms", "cpu")
 
 
+def pytest_configure(config):
+    config.addinivalue_line(
+        "markers",
+        "slow: soak-tier tests excluded from the tier-1 run (-m 'not slow')")
+
+
 class FakeClock:
     """Shared virtual clock for the fake-cluster suites."""
 

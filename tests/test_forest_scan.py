@@ -100,3 +100,41 @@ def test_forest_scan_tight_bucket():
         n_forests=packed.n_forests, max_forest_wl=max_group)
     np.testing.assert_array_equal(np.asarray(flat[0]),
                                   np.asarray(forest[0]))
+
+
+def test_forest_schedule_parity_under_gspmd_sharding():
+    """Regression: ``_forest_schedule`` once computed segment starts
+    with ``lax.associative_scan(maximum)``, which miscompiles under
+    GSPMD when the input is sharded over a mesh axis of size >= 4 (the
+    production (wl, cq) admit-scan mesh) — positions read partial
+    maxima from other shards' blocks, collapsing most forests' ranks
+    and silently dropping admissions at W >= 128.  The sharded result
+    must be bit-identical to the unsharded one."""
+    import jax
+    import jax.numpy as jnp
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    from kueue_tpu.ops.cycle import _forest_schedule
+    from kueue_tpu.parallel.sharded import make_mesh
+
+    if len(jax.devices()) < 8:
+        pytest.skip("needs 8 virtual devices (tests/conftest.py)")
+
+    W, n_forests, max_forest_wl = 128, 32, 16
+    rng = np.random.default_rng(1109)
+    f_w = jnp.asarray(rng.integers(0, n_forests, W), dtype=jnp.int32)
+    order = jnp.asarray(rng.permutation(W), dtype=jnp.int32)
+    G = n_forests + 1
+
+    fn = jax.jit(_forest_schedule, static_argnums=(2, 3, 4))
+    want = np.asarray(fn(order, f_w, W, G, max_forest_wl))
+
+    mesh = make_mesh(8)                       # (wl=4, cq=2) — the shape
+    shard = NamedSharding(mesh, P("wl"))      # that exposed the bug
+    got = np.asarray(fn(jax.device_put(order, shard),
+                        jax.device_put(f_w, shard),
+                        W, G, max_forest_wl))
+    np.testing.assert_array_equal(got, want)
+    # sanity: every workload keeps exactly one seat
+    seats = got[got >= 0]
+    assert len(seats) == W and len(set(seats.tolist())) == W

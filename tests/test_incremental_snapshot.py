@@ -1,0 +1,125 @@
+"""Incremental snapshot maintenance (cache/cache.py _SnapCache).
+
+The open-loop refactor's correctness contract: a snapshot built by
+re-cloning only journal-dirty / consumer-mutated trees must be
+indistinguishable from the old full-rebuild-every-cycle snapshot —
+decisions, usage, everything — while the counters prove the per-cycle
+cost is O(dirty rows), not O(universe).
+"""
+
+from __future__ import annotations
+
+from kueue_tpu.resources import FlavorResource
+
+from test_burst import add_workloads, build, mk, run_host, simple_cluster
+
+
+def drain_spec(n_cohorts=2, cqs=2, n_wl=6):
+    wls = []
+    n = 0
+    for c in range(n_cohorts):
+        for q in range(cqs):
+            for i in range(n_wl):
+                n += 1
+                wls.append(mk(f"w-{c}-{q}-{i}", f"lq-{c}-{q}", 1500,
+                              prio=(i % 3) * 10, t=float(n)))
+    return add_workloads(simple_cluster(n_cohorts=n_cohorts, cqs=cqs), wls)
+
+
+def _admissions(stats_list):
+    return [sorted(s.admitted) for s in stats_list]
+
+
+def test_incremental_matches_full_rebuild():
+    da, ca = build(drain_spec(), use_device=False)
+    db, cb = build(drain_spec(), use_device=False)
+    db.cache._snap_incremental = False          # the old full-rebuild path
+    out_a = run_host(da, ca, cycles=12, runtime=2)
+    out_b = run_host(db, cb, cycles=12, runtime=2)
+    assert _admissions(out_a) == _admissions(out_b)
+    sa, sb = da.cache.snapshot_stats, db.cache.snapshot_stats
+    assert sa["snap_incremental"] > 0           # the fast path actually ran
+    assert sb["snap_incremental"] == 0          # control never took it
+    assert sb["snap_full"] == sb["snap_builds"]
+    # live stores ended identical too
+    for name, cq in da.cache._mgr.cluster_queues.items():
+        assert sorted(cq.workloads) == sorted(
+            db.cache._mgr.cluster_queues[name].workloads)
+
+
+def test_snapshot_cost_is_scoped_to_dirty_trees():
+    d, clock = build(drain_spec(), use_device=False)
+    run_host(d, clock, cycles=30, runtime=2)    # drain to quiescence
+    assert all(d.queues.pending_workloads(n) == 0
+               for n in d.cache._mgr.cluster_queues)
+    d.cache.snapshot()                          # flush residual journal dirt
+    # zero dirt: the whole forest is reused, nothing re-cloned
+    before = dict(d.cache.snapshot_stats)
+    d.cache.snapshot()
+    after = dict(d.cache.snapshot_stats)
+    assert after["snap_full"] == before["snap_full"]
+    assert after["snap_cqs_recloned"] == before["snap_cqs_recloned"]
+    assert after["snap_trees_reused"] == before["snap_trees_reused"] + 2
+    # one admission on lq-0-0 dirties exactly tree co-0: the next build
+    # re-clones that tree's 2 CQs and reuses co-1 — O(dirty), not O(all)
+    d.create_workload(mk("fresh", "lq-0-0", 1500, t=clock.t))
+    clock.t += 1.0
+    assert d.schedule_once().admitted == ["default/fresh"]
+    before = dict(d.cache.snapshot_stats)
+    d.cache.snapshot()
+    after = dict(d.cache.snapshot_stats)
+    assert after["snap_trees_recloned"] == before["snap_trees_recloned"] + 1
+    assert after["snap_trees_reused"] == before["snap_trees_reused"] + 1
+    assert after["snap_cqs_recloned"] == before["snap_cqs_recloned"] + 2
+    assert after["snap_cqs_reused"] == before["snap_cqs_reused"] + 2
+
+
+def test_structure_edit_forces_full_rebuild():
+    d, clock = build(drain_spec(), use_device=False)
+    run_host(d, clock, cycles=3, runtime=2)
+    before = dict(d.cache.snapshot_stats)
+    gen = d.cache.structure_generation
+    simple_cluster(n_cohorts=3, cqs=2)(d)       # spec churn: adds co-2
+    assert d.cache.structure_generation > gen
+    clock.t += 1.0
+    d.schedule_once()
+    after = d.cache.snapshot_stats
+    assert after["snap_full"] == before["snap_full"] + 1
+
+
+def test_touch_all_poisoning_forces_full_rebuild():
+    # the chaos drop_touch recovery path: when a journal touch may have
+    # been lost, touch_all() poisons the snapshot channel and the next
+    # build falls back to a full re-clone instead of trusting the cache
+    d, clock = build(drain_spec(), use_device=False)
+    run_host(d, clock, cycles=3, runtime=2)
+    before = dict(d.cache.snapshot_stats)
+    d.cache.pack_journal.touch_all()
+    clock.t += 1.0
+    d.schedule_once()
+    after = d.cache.snapshot_stats
+    assert after["snap_full"] == before["snap_full"] + 1
+
+
+def test_consumer_mutation_recloned_sibling_reused():
+    d, clock = build(drain_spec(), use_device=False)
+    run_host(d, clock, cycles=30, runtime=2)    # quiescent from here on
+    snap1 = d.cache.snapshot()
+    a1 = snap1.cluster_queues["cq-0-0"]
+    b1 = snap1.cluster_queues["cq-1-0"]
+    # a consumer scribbles on tree co-0's clone and never reverts (the
+    # scheduler's preemption-simulation failure mode SnapTag guards)
+    a1.simulate_usage_addition({FlavorResource("default", "cpu"): 999})
+    before = dict(d.cache.snapshot_stats)
+    snap2 = d.cache.snapshot()
+    after = d.cache.snapshot_stats
+    # mutated tree re-cloned — the scribble must not leak forward
+    assert snap2.cluster_queues["cq-0-0"] is not a1
+    # untouched sibling tree reused verbatim
+    assert snap2.cluster_queues["cq-1-0"] is b1
+    assert after["snap_full"] == before["snap_full"]
+    assert after["snap_cqs_recloned"] > before["snap_cqs_recloned"]
+    assert after["snap_cqs_reused"] > before["snap_cqs_reused"]
+    fr = FlavorResource("default", "cpu")
+    assert snap2.cluster_queues["cq-0-0"].available(fr) \
+        == d.cache.cluster_queue("cq-0-0").available(fr)

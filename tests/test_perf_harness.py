@@ -63,3 +63,23 @@ def test_rangespec_checker(stats):
     }
     failures = check_rangespec(stats, bad_spec)
     assert len(failures) == 4
+
+
+def test_ab_block_requires_interleaved_control():
+    from kueue_tpu.perf.harness import MissingControlArm, ab_block
+
+    treatment = {"arm": "shards_8", "p99_ms": 12.0}
+    control = {"arm": "serial", "p99_ms": 15.0, "interleaved": True}
+    block = ab_block(treatment, control)
+    assert block["treatment"]["arm"] == "shards_8"
+    assert block["control"]["interleaved"] is True
+    with pytest.raises(MissingControlArm):
+        ab_block(treatment, None)
+    with pytest.raises(MissingControlArm):
+        ab_block(treatment, {})
+    with pytest.raises(MissingControlArm):
+        # a control measured in a different run/box is not a control
+        ab_block(treatment, {"arm": "serial", "p99_ms": 15.0})
+    relabeled = ab_block(treatment, control, treatment_label="sharded",
+                         control_label="serial_control")
+    assert set(relabeled) == {"sharded", "serial_control"}

@@ -1,0 +1,56 @@
+"""Seconds-level smoke of the two soak entry points (satellite of the
+open-loop traffic PR): ``--quick`` must stay wired, exit clean, and
+emit schema-valid artifacts.  Marked ``slow`` — these spawn real soak
+subprocesses (~1-2 min each) and belong to the soak tier, not tier-1.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import subprocess
+import sys
+
+import pytest
+
+pytestmark = pytest.mark.slow
+
+ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _run_quick(script, out_path, extra=()):
+    env = dict(os.environ, JAX_PLATFORMS="cpu")
+    proc = subprocess.run(
+        [sys.executable, os.path.join(ROOT, "scripts", script),
+         "--quick", "--out", out_path, *extra],
+        cwd=ROOT, env=env, capture_output=True, text=True, timeout=600)
+    assert proc.returncode == 0, \
+        f"{script} --quick failed:\n{proc.stdout[-2000:]}\n{proc.stderr[-2000:]}"
+    with open(out_path) as f:
+        return json.load(f)
+
+
+def _validate(out_path):
+    sys.path.insert(0, os.path.join(ROOT, "scripts"))
+    try:
+        import validate_artifacts
+        return validate_artifacts.validate(out_path)
+    finally:
+        sys.path.pop(0)
+
+
+def test_traffic_soak_quick(tmp_path):
+    out = str(tmp_path / "TRAFFIC_r99.json")
+    d = _run_quick("traffic_soak.py", out, extra=("--shards", "2"))
+    assert d["quick"] is True
+    assert d["replay_identical"] is True
+    assert d["serial_shard_decisions_match"] is True
+    assert d["control"]["interleaved"] is True
+    assert _validate(out) == []
+
+
+def test_chaos_soak_quick(tmp_path):
+    out = str(tmp_path / "CHAOS_r99.json")
+    d = _run_quick("chaos_soak.py", out)
+    assert d["all_stable"] is True
+    assert _validate(out) == []

@@ -1,0 +1,101 @@
+"""Seeded arrival-process determinism (traffic/arrivals.py).
+
+The replayability story rests on the stream being a pure function of
+its seed: same seed → identical event sequence across runs, across
+process restarts (no PYTHONHASHSEED leakage), and across a pickle
+round-trip mid-stream (the soak checkpoints streams between probes).
+"""
+
+import pickle
+
+import pytest
+
+from kueue_tpu.traffic import (
+    ArrivalStream,
+    DiurnalProcess,
+    MMPPProcess,
+    PoissonProcess,
+    ReplayStream,
+    TrafficSpec,
+)
+
+SPEC = TrafficSpec(n_cqs=8, cpu_choices=(500, 1500), priorities=(0, 10, 20),
+                   runtime_choices_s=(2.0, 4.0), cancel_fraction=0.05,
+                   churn_fraction=0.05, remote_fraction=0.25)
+
+
+def _procs(seed):
+    return [
+        PoissonProcess(5.0, seed=seed),
+        DiurnalProcess(1.0, 10.0, period_s=60.0, seed=seed),
+        MMPPProcess(1.0, 20.0, mean_dwell_s=5.0, seed=seed),
+    ]
+
+
+@pytest.mark.parametrize("i", range(3))
+def test_same_seed_identical_stream(i):
+    a = ArrivalStream(_procs(11)[i], SPEC, seed=11).take(300)
+    b = ArrivalStream(_procs(11)[i], SPEC, seed=11).take(300)
+    assert a == b
+
+
+@pytest.mark.parametrize("i", range(3))
+def test_different_seed_differs(i):
+    a = ArrivalStream(_procs(11)[i], SPEC, seed=11).take(100)
+    b = ArrivalStream(_procs(12)[i], SPEC, seed=12).take(100)
+    assert a != b
+
+
+@pytest.mark.parametrize("i", range(3))
+def test_pickle_roundtrip_resumes_identical_tail(i):
+    live = ArrivalStream(_procs(7)[i], SPEC, seed=7)
+    live.take(150)                      # consume a prefix, then checkpoint
+    clone = pickle.loads(pickle.dumps(live))
+    assert live.take(50) == clone.take(50)
+
+
+def test_event_shape_and_marks():
+    evs = ArrivalStream(PoissonProcess(10.0, seed=3), SPEC, seed=3).take(500)
+    # monotone virtual time
+    assert all(e1.t <= e2.t for e1, e2 in zip(evs, evs[1:]))
+    kinds = {e.kind for e in evs}
+    assert kinds == {"submit", "cancel", "priority"}
+    submitted = set()
+    for e in evs:
+        if e.kind == "submit":
+            assert 0 <= e.cq < SPEC.n_cqs
+            assert e.cpu_m in SPEC.cpu_choices
+            assert e.priority in SPEC.priorities
+            assert e.runtime_s in SPEC.runtime_choices_s
+            assert e.key not in submitted   # keys never reused
+            submitted.add(e.key)
+        else:
+            # cancels/churns always target a previously-submitted key
+            assert e.key in submitted
+    assert any(e.remote for e in evs if e.kind == "submit")
+
+
+def test_cancel_removes_key_from_pool():
+    evs = ArrivalStream(PoissonProcess(10.0, seed=5), SPEC, seed=5).take(2000)
+    cancelled = set()
+    for e in evs:
+        if e.kind == "cancel":
+            assert e.key not in cancelled   # a key cancels at most once
+            cancelled.add(e.key)
+    assert cancelled
+
+
+def test_replay_stream_is_finite_and_faithful():
+    evs = ArrivalStream(MMPPProcess(2.0, 8.0, 3.0, seed=9), SPEC,
+                        seed=9).take(64)
+    assert list(ReplayStream(evs)) == evs
+    rs = ReplayStream(evs)
+    list(rs)
+    assert list(rs) == []               # exhausted, stays exhausted
+
+
+def test_describe_carries_process_params():
+    s = ArrivalStream(DiurnalProcess(1.0, 4.0, 60.0, seed=2), SPEC, seed=2)
+    d = s.describe()
+    assert d["process"] == "diurnal"
+    assert d["seed"] == 2 and d["n_cqs"] == SPEC.n_cqs
