@@ -1279,6 +1279,19 @@ class Driver:
         }
         if self._burst_solver is not None:
             out["burst"] = dict(self._burst_solver.stats)
+        solver = self.scheduler.solver
+        if solver is not None and hasattr(solver, "stats"):
+            ss = solver.stats
+            out["flavor_walk"] = {
+                "host_cycles": ss.get("host_cycles", 0),
+                "scalar_heads": ss.get("scalar_heads", 0),
+                "scalar_reasons": dict(ss.get("scalar_reasons", {})),
+                "resume_heads": ss.get("resume_heads", 0),
+                "walk_stop_heads": ss.get("walk_stop_heads", 0),
+                "native_ff_fallbacks": ss.get("native_ff_fallbacks", 0),
+            }
+        self.metrics.burst_solver_sample(out.get("burst"),
+                                         out.get("flavor_walk"))
         return out
 
     def admitted_keys(self) -> set[str]:

@@ -203,6 +203,28 @@ class Registry:
         self.set_gauge("kueue_open_loop_requeue_storm_peak", (),
                        max(cur, size))
 
+    # -- heterogeneous fast-path series (ops/solver.py + ops/burst.py
+    #    classify routing and host-fallback visibility; sampled by
+    #    Driver.stats so the perf harness and /metrics agree) --
+
+    def burst_solver_sample(self, burst_stats=None, walk_stats=None) -> None:
+        """Publish the burst solver's dirty/fallback counters and the
+        cycle solver's flavor-walk telemetry as ``kueue_burst_*`` gauges."""
+        if burst_stats:
+            for k in ("burst_dispatches", "burst_cycles_decided",
+                      "burst_suppressed_cycles", "burst_dirty_cycles",
+                      "burst_dirty_preempt", "burst_dirty_scalar",
+                      "burst_dirty_resume"):
+                self.set_gauge("kueue_" + k, (), float(burst_stats.get(k, 0)))
+        if walk_stats:
+            for k in ("host_cycles", "scalar_heads", "resume_heads",
+                      "walk_stop_heads", "native_ff_fallbacks"):
+                self.set_gauge(f"kueue_burst_{k}", (),
+                               float(walk_stats.get(k, 0)))
+            for reason, n in walk_stats.get("scalar_reasons", {}).items():
+                self.set_gauge("kueue_burst_scalar_heads_by_reason",
+                               (reason,), float(n))
+
     def report_weighted_share(self, cq: str, share: float) -> None:
         self.set_gauge("kueue_cluster_queue_weighted_share", (cq,), share)
 
@@ -258,6 +280,7 @@ LABEL_NAMES = {
         ("namespace", "local_queue"),
     "kueue_local_queue_admitted_active_workloads":
         ("namespace", "local_queue"),
+    "kueue_burst_scalar_heads_by_reason": ("reason",),
     "kueue_open_loop_queue_depth": ("status",),
     "kueue_open_loop_pending_age_seconds": ("quantile",),
     "kueue_open_loop_admissions_per_second": (),

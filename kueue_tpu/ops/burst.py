@@ -38,10 +38,16 @@ Semantics reproduced per fused cycle, bit-matching the host scheduler:
    schedule for workloads admitted before the burst.
 
 Anything the fused math can't decide bit-identically makes the cycle
-**dirty**: a preempt-capable head (needs the host preemption search), a
-head outside the vectorized classify's coverage (multi-RG / multi-PodSet
-/ taints / TAS / partial admission — ``vec_ok`` False), or a head with
-fungibility resume state.  The kernel reports the first dirty cycle and
+**dirty**: a preempt-capable head outside the modeled envelope (the
+walk neither policy-stopped on the preempt slot nor left it as the only
+preempt-capable choice — the host's pick then depends on the reclaim
+oracle), or a head outside the vectorized classify's coverage (multi-RG
+/ multi-PodSet / taints / TAS / partial admission — ``vec_ok`` False).
+FlavorFungibility itself runs in-kernel: the classify step walks each
+head's flavor list from its carried resume start slot with the
+whenCanBorrow/whenCanPreempt stop rules and records the next start slot
+exactly as the host records last_tried_flavor_idx.  The kernel reports
+the first dirty cycle and
 the host applies only the clean prefix, running the normal per-cycle
 path from there.  Decisions are additionally validated on application:
 the driver compares each cycle's modeled heads against the live queues
@@ -110,7 +116,8 @@ def _burst_cycles(
     vec_ok,          # [C, M] bool  vectorized-classify coverage
     elig0,           # [C, M] bool  in the heap at burst start
     parked0,         # [C, M] bool  in the inadmissible lot at burst start
-    resume0,         # [C, M] bool  fungibility resume state pending
+    resume0,         # [C, M] int32 flavor-walk start slot (fungibility
+                     #              resume state; 0 = full walk)
     # admitted-row state (rows holding quota at burst start)
     adm0,            # [C, M] bool
     adm_seq0,        # [C, M] int32 reservation-time dense rank (ties ==)
@@ -130,6 +137,8 @@ def _burst_cycles(
     slot_fr,         # [C, S, R] int32 F-index or -1
     slot_valid,      # [C, S] bool
     cq_can_preempt_borrow,                       # [C] bool
+    cq_wcb,          # [C] bool whenCanBorrow == Borrow
+    cq_wcp,          # [C] bool whenCanPreempt == Preempt
     forest_of_cq,    # [C] int32
     strict_cq,       # [C] bool StrictFIFO
     # preemption policy + modeling envelope (static per structure)
@@ -182,6 +191,10 @@ def _burst_cycles(
     root_of_cq = jnp.maximum(parent[:C], 0)  # depth<=2 inside envelope
     sq_root = subtree[root_of_cq]            # [C, F]
     bit_w = (jnp.uint32(1) << jnp.arange(32, dtype=jnp.uint32))
+    # per-CQ flavor-list length: vector-ok CQs have every rg flavor
+    # materialized as a valid slot (solver._cq_vector_ok), so the valid
+    # count IS len(rg.flavors) — the host walk's n_slots
+    slot_cnt = jnp.sum(slot_valid, axis=1).astype(jnp.int32)   # [C]
 
     # per-CQ static candidate tables (gathered per forest)
     crows = cand_rows[forest_of_cq]                    # [C, KC]
@@ -266,17 +279,42 @@ def _burst_cycles(
                  & ~missing & slot_valid)                      # [C,S]
         nofit_s = jnp.any(res_nofit, axis=2) | missing | ~slot_valid
         preempt_s = ~fit_s & ~nofit_s
-        has_fit = jnp.any(fit_s, axis=1) & has_head
-        fit_idx = jnp.argmax(fit_s, axis=1).astype(jnp.int32)
-        fit_slot = jnp.where(has_fit, fit_idx, -1)
         borrow_r = jnp.where(relevant, use + req[:, None, :] > sq, False)
         borrows_s = jnp.any(borrow_r, axis=2) & has_parent_cq[:, None]
-        borrows = borrows_s[cidx, fit_idx] & has_fit
-        has_preempt = ~has_fit & jnp.any(preempt_s, axis=1) & has_head
 
-        # -- preempt head facts on the (unique) preempt slot ----------
-        p_idx = jnp.argmax(preempt_s, axis=1).astype(jnp.int32)
-        p_count = preempt_s.sum(axis=1)
+        # -- fungibility walk (flavorassigner.go:326-391 dense twin) --
+        # scan the flavor list from the carried resume start; STOP on a
+        # slot per whenCanBorrow/whenCanPreempt, else keep the best mode
+        # (first occurrence of max: FIT=2 > PREEMPT=1 > NO_FIT=0)
+        start = resume[cidx, row]                              # [C]
+        active_s = (jnp.arange(S, dtype=jnp.int32)[None, :]
+                    >= start[:, None])                         # [C,S]
+        stop_s = (active_s & (fit_s | (preempt_s & cq_wcp[:, None]))
+                  & (~borrows_s | cq_wcb[:, None]))
+        has_stop = jnp.any(stop_s, axis=1)
+        act_mode = jnp.where(active_s,
+                             jnp.where(fit_s, 2,
+                                       jnp.where(preempt_s, 1, 0)), 0)
+        best_mode = act_mode.max(axis=1)
+        best_idx = jnp.argmax((act_mode == best_mode[:, None]) & active_s,
+                              axis=1).astype(jnp.int32)
+        chosen = jnp.where(has_stop,
+                           jnp.argmax(stop_s, axis=1).astype(jnp.int32),
+                           best_idx)
+        chosen_mode = act_mode[cidx, chosen]
+        has_fit = (chosen_mode == 2) & has_head
+        fit_slot = jnp.where(has_fit, chosen, -1)
+        borrows = borrows_s[cidx, chosen] & has_fit
+        has_preempt = (chosen_mode == 1) & has_head
+        # the resume state the host records for this walk: the stop slot
+        # when it stopped mid-list, else -1 (whole list attempted)
+        tried_c = jnp.where(has_stop & (chosen < slot_cnt - 1),
+                            chosen, -1)
+        pending_c = tried_c >= 0
+
+        # -- preempt head facts on the chosen preempt slot ------------
+        p_idx = chosen
+        p_count = (preempt_s & active_s).sum(axis=1)
         p_borrows = borrows_s[cidx, p_idx] & has_preempt
         pfrs = slot_fr[cidx, p_idx]                            # [C, R]
         prel = (pfrs >= 0) & (req > 0)
@@ -286,14 +324,15 @@ def _burst_cycles(
             cidx[:, None], pfrs_s].max(prel & ~pfit_r)         # [C, F]
         wu = jnp.zeros((C, F), dtype=jnp.int32).at[
             cidx[:, None], pfrs_s].add(jnp.where(prel, req, 0))
-        # the modeled envelope: one preempt-capable slot (no reclaim
-        # oracle, cycle.py:122-126) and no untried flavors after it (no
-        # fungibility resume state can arise from skips)
-        pre_model = (has_preempt & preempt_ok & (p_count == 1)
-                     & (p_idx == S - 1))
+        # the modeled envelope: the preempt choice must not depend on
+        # the reclaim oracle (cycle.py:122-126) — a policy-stopped walk
+        # is final, and a single preempt-capable slot leaves the
+        # best-mode pick no freedom either
+        pre_model = (has_preempt & preempt_ok
+                     & (has_stop | (p_count == 1)))
 
         dirty_c = has_head & ((has_preempt & ~pre_model)
-                              | ~vec_ok[cidx, row] | resume[cidx, row])
+                              | ~vec_ok[cidx, row])
         # dirty/dirty_reason are the kernel's ONLY cross-forest
         # quantities (everything else is forest-local), and nothing in
         # the scan's state transitions reads the GLOBAL flags (park_new
@@ -305,7 +344,9 @@ def _burst_cycles(
             jnp.any(dirty_c).astype(jnp.int32),
             jnp.any(has_preempt & ~pre_model).astype(jnp.int32),
             jnp.any(has_head & ~vec_ok[cidx, row]).astype(jnp.int32),
-            jnp.any(has_head & resume[cidx, row]).astype(jnp.int32)])
+            # fungibility resume runs in-kernel now; the DIRTY_RESUME
+            # lane stays for flag-layout compatibility and is always 0
+            jnp.zeros((), dtype=jnp.int32)])
 
         # -- nominate-time preemption searches (preemption.go:127-342) -
         def run_searches(_):
@@ -636,18 +677,26 @@ def _burst_cycles(
             cidx[:, None], afrs_s].max(arel)
 
         skipped = has_fit & ~admitted_c            # stays eligible
+        # a reserve head whose walk stopped mid-list keeps pending
+        # flavors: the host requeues it immediately (cluster_queue.py
+        # _requeue_if_not_present) so it stays eligible, not parked
         park_new = ((has_head & ~has_fit & ~has_preempt & ~dirty_c)
-                    | reserve_c) & ~strict_cq
+                    | (reserve_c & ~pending_c)) & ~strict_cq
         gone = admitted_c | park_new
         elig = elig.at[cidx, row].set(
             jnp.where(gone, False, elig[cidx, row]))
         parked = parked.at[cidx, row].set(
             park_new | parked[cidx, row])
-        # fungibility resume: a skipped fit head that did not try the
-        # whole flavor list restarts mid-walk next time → dirty then
+        # fungibility resume: heads whose walk stopped mid-list and that
+        # requeue with the recorded last_state restart at tried+1
+        # (skip / pending reserve / overlap-skip / preempt-nofit);
+        # everything else — admit (a later eviction requeues a FRESH
+        # Info), park, preempt issued, strict NoFit — resets to 0
+        keep_resume = (skipped | (reserve_c & pending_c) | overlap_c
+                       | pre_nofit_c)
+        head_start = jnp.where(keep_resume & pending_c, tried_c + 1, 0)
         resume = resume.at[cidx, row].set(
-            resume[cidx, row] | (skipped & (fit_slot >= 0)
-                                 & (fit_slot < S - 1)))
+            jnp.where(has_head, head_start, resume[cidx, row]))
         # admitted rows join the quota-holding table
         adm = adm.at[cidx, row].set(admitted_c | adm[cidx, row])
         adm_seq = adm_seq.at[cidx, row].set(
@@ -821,7 +870,7 @@ def burst_probe(C: int, M: int, R: int, K: int, runtime: int = 4):
     return burst_cycles(
         d["wl_req"], d["wl_rank"], d["wl_cycle_rank"],
         zeros_cm, zeros_cm,
-        d["vec_ok"], d["elig0"], d["parked0"], d["resume0"],
+        d["vec_ok"], d["elig0"], d["parked0"], zeros_cm,
         np.zeros((C, M), bool), zeros_cm,
         np.zeros((C, M, F), np.int32), np.zeros((C, M, F), bool),
         np.full((C, M), I32_MAX, np.int32), np.int32(1),
@@ -830,7 +879,9 @@ def burst_probe(C: int, M: int, R: int, K: int, runtime: int = 4):
         d["has_blim"], d["parent"], d["node_level"], d["nominal_cq"],
         np.full((C, F), I32_MAX, np.int32),
         d["slot_fr"], d["slot_valid"],
-        d["cq_can_preempt_borrow"], d["forest_of_cq"], d["strict_cq"],
+        d["cq_can_preempt_borrow"],
+        np.ones(C, bool), np.zeros(C, bool),
+        d["forest_of_cq"], d["strict_cq"],
         np.zeros(C, bool), np.zeros(C, bool), np.zeros(C, bool),
         np.zeros(C, bool),
         d["members"], cand_rows, cand_lmem, self_lmem,
@@ -1169,7 +1220,6 @@ def _pack_cq_rows(st, ci, pos, queues, cache, scheduler, assumed,
     if cq_vec and cq_live.spec.namespace_selector:
         cq_vec = False   # selector evaluation stays on the host path
     lr_summaries = scheduler.limit_range_summaries
-    allocatable = cq_live.allocatable_generation
 
     n_upper = len(members) + len(admitted)
     prio_l: list[int] = []
@@ -1177,7 +1227,7 @@ def _pack_cq_rows(st, ci, pos, queues, cache, scheduler, assumed,
     res_ts_l: list[float] = []
     parked_l: list[bool] = []
     ok_l: list[bool] = []
-    resume_l: list[bool] = []
+    resume_l: list[int] = []      # flavor-walk start slot (0 = full)
     key_l: list[str] = []
     uid_l: list[str] = []
     infos: list = []
@@ -1213,11 +1263,8 @@ def _pack_cq_rows(st, ci, pos, queues, cache, scheduler, assumed,
                     for stt in obj.admission_check_states.values()):
                 ok = False
         ok_l.append(ok)
-        last = info.last_assignment
-        resume_l.append(
-            last is not None
-            and getattr(last, "pending_flavors", False)
-            and last.cluster_queue_generation >= allocatable)
+        from .solver import resume_start
+        resume_l.append(resume_start(info, cq_live, covers_pods))
         infos.append(info)
         i += 1
     rec.n_pend = i
@@ -1260,7 +1307,7 @@ def _pack_cq_rows(st, ci, pos, queues, cache, scheduler, assumed,
                     for stt in obj.admission_check_states.values()):
                 ok = False
         ok_l.append(ok)
-        resume_l.append(False)
+        resume_l.append(0)
         infos.append(info)
         i += 1
     rec.n_adm = i - rec.n_pend
@@ -1274,7 +1321,7 @@ def _pack_cq_rows(st, ci, pos, queues, cache, scheduler, assumed,
     rec.res_ts = np.array(res_ts_l, dtype=np.float64)
     rec.parked = np.array(parked_l, dtype=bool)
     rec.ok = np.array(ok_l, dtype=bool)
-    rec.resume = np.array(resume_l, dtype=bool)
+    rec.resume = np.array(resume_l, dtype=np.int32)
     adm = np.zeros(i, dtype=bool)
     adm[rec.n_pend:] = True
     rec.adm = adm
@@ -1424,7 +1471,7 @@ def _assemble_plan(st, records, cache, scheduler, min_m,
     vec_ok = np.zeros((C, M), dtype=bool)
     elig = np.zeros((C, M), dtype=bool)
     parked = np.zeros((C, M), dtype=bool)
-    resume = np.zeros((C, M), dtype=bool)
+    resume = np.zeros((C, M), dtype=np.int32)
     adm = np.zeros((C, M), dtype=bool)
     adm_seq = np.zeros((C, M), dtype=np.int32)
     adm_usage = np.zeros((C, M, F), dtype=np.int32)
@@ -1532,6 +1579,7 @@ def _assemble_plan(st, records, cache, scheduler, min_m,
         nominal_cq=st.nominal_cq, npb_cq=st.nominal_plus_blimit_cq,
         slot_fr=st.slot_fr, slot_valid=st.slot_valid,
         cq_can_preempt_borrow=st.cq_can_preempt_borrow,
+        cq_wcb_borrow=st.cq_wcb_borrow, cq_wcp_preempt=st.cq_wcp_preempt,
         forest_of_cq=forest_of_cq, strict_cq=strict,
         wcq_lower=s.wcq_lower, rwc_enabled=s.rwc_enabled,
         rwc_only_lower=s.rwc_only_lower, preempt_ok=preempt_ok,
@@ -1594,14 +1642,14 @@ class DeltaPackState:
         self.token = next(DeltaPackState._next_token)
 
 
-def _roundtrips_clean(rec, q, cq_live, keys) -> bool:
+def _roundtrips_clean(rec, q, cq_live, keys, covers_pods) -> bool:
     """Verify that popped-and-requeued heads still match their packed
-    rows: same Info object, same parked bit, same flavor-resume bit.
-    These are the only row facts a pop/requeue roundtrip can move
-    without hitting a hard journal touch."""
+    rows: same Info object, same parked bit, same flavor-walk start
+    slot.  These are the only row facts a pop/requeue roundtrip can
+    move without hitting a hard journal touch."""
+    from .solver import resume_start
     if q is None or not q.active or cq_live is None:
         return False
-    allocatable = cq_live.allocatable_generation
     for key in keys:
         parked_now = False
         info = q.heap.get(key)
@@ -1623,12 +1671,8 @@ def _roundtrips_clean(rec, q, cq_live, keys) -> bool:
             return False
         if bool(rec.parked[idx]) != parked_now:
             return False
-        last = info.last_assignment
-        resume_now = (
-            last is not None
-            and getattr(last, "pending_flavors", False)
-            and last.cluster_queue_generation >= allocatable)
-        if bool(rec.resume[idx]) != resume_now:
+        if int(rec.resume[idx]) != resume_start(info, cq_live,
+                                                covers_pods):
             return False
     return True
 
@@ -1719,7 +1763,8 @@ def pack_burst_cached(structure, queues, cache, scheduler, clock,
             continue
         if not _roundtrips_clean(state.records[ci],
                                  queues.queue_for(name),
-                                 cache.cluster_queue(name), skeys):
+                                 cache.cluster_queue(name), skeys,
+                                 name in structure.cq_covers_pods):
             dirty.add(name)
 
     # at full churn the per-CQ delta walk is a near-complete rebuild
@@ -2083,6 +2128,7 @@ class BurstSolver:
                 a["borrow_cap"], a["has_blim"], a["parent"],
                 a["node_level"], a["nominal_cq"], a["npb_cq"],
                 a["slot_fr"], a["slot_valid"], a["cq_can_preempt_borrow"],
+                a["cq_wcb_borrow"], a["cq_wcp_preempt"],
                 a["forest_of_cq"], a["strict_cq"],
                 a["wcq_lower"], a["rwc_enabled"], a["rwc_only_lower"],
                 a["preempt_ok"],
@@ -2309,6 +2355,7 @@ class BurstSolver:
             a["borrow_cap"], a["has_blim"], a["parent"],
             a["node_level"], a["nominal_cq"], a["npb_cq"],
             a["slot_fr"], a["slot_valid"], a["cq_can_preempt_borrow"],
+            a["cq_wcb_borrow"], a["cq_wcp_preempt"],
             a["forest_of_cq"], a["strict_cq"],
             a["wcq_lower"], a["rwc_enabled"], a["rwc_only_lower"],
             a["preempt_ok"],

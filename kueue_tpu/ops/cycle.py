@@ -53,18 +53,29 @@ def available_all_np(usage, subtree, guaranteed, borrow_cap, has_blim,
     return avail
 
 
-def classify_np(packed, avail0=None, potential0=None):
+def classify_np(packed, avail0=None, potential0=None, start_slot=None):
     """Vectorized nominate on the host: per-head slot classification.
 
+    The per-head flavor walk (flavorassigner.go:499) is evaluated dense
+    over all slots and then resolved under the CQ's FlavorFungibility
+    policy: a slot STOPS the walk when it fits without borrowing, fits
+    borrowing under whenCanBorrow=Borrow, or is preempt-capable under
+    whenCanPreempt=Preempt (shouldTryNextFlavor, :620); otherwise the
+    walk keeps the best-mode slot seen (Fit > Preempt > NoFit, first
+    occurrence wins), with a stop slot overriding any earlier best.
+    ``start_slot`` [W] carries the fungibility resume index
+    (last_tried_flavor_idx + 1); slots below it are never attempted.
+
     Returns a dict of [W]-shaped arrays:
-      fit_slot0     first Fit slot or -1 (classify(avail0), first-fit under
-                    default fungibility — flavorassigner.go:499)
+      fit_slot0     the walk's chosen Fit slot or -1
       borrows0      the fit assignment borrows
-      preempt0      no fit, but some slot is preempt-capable
-      preempt_slot0 first preempt-capable slot (best under default policy)
+      preempt0      no fit chosen, the walk chose a preempt-capable slot
+      preempt_slot0 that slot
       preempt_borrows0  that preempt assignment borrows
       preempt_res_fit   [W, R] per-resource Fit flag on the preempt slot
                     (False ⇒ the resource is the one needing preemption)
+      preempt_stopped0  the walk STOPPED at the preempt slot (the choice
+                    is policy-forced, independent of the reclaim oracle)
     """
     st = packed.structure
     usage0 = packed.usage0
@@ -107,23 +118,44 @@ def classify_np(packed, avail0=None, potential0=None):
     borrows_s = np.any(borrow_r, axis=2) & has_parent[:, None]
 
     valid = wl_cq >= 0
-    has_fit = np.any(fit_s, axis=1) & valid
-    fit_idx = np.argmax(fit_s, axis=1)
-    fit_slot0 = np.where(has_fit, fit_idx, -1).astype(np.int32)
-    w = np.arange(len(cqs))
-    borrows0 = borrows_s[w, fit_idx] & has_fit
+    W = len(cqs)
+    S = fit_s.shape[1]
+    w = np.arange(W)
+    wcb = st.cq_wcb_borrow[cqs]
+    wcp = st.cq_wcp_preempt[cqs]
+    if start_slot is None:
+        start = np.zeros(W, dtype=np.int32)
+    else:
+        start = np.asarray(start_slot, dtype=np.int32)
+    active_s = np.arange(S)[None, :] >= start[:, None]      # [W, S]
+    stop_s = (active_s & (fit_s | (preempt_s & wcp[:, None]))
+              & (~borrows_s | wcb[:, None]))
+    has_stop = np.any(stop_s, axis=1)
+    stop_idx = np.argmax(stop_s, axis=1)
+    act_mode = np.where(active_s,
+                        np.where(fit_s, 2, np.where(preempt_s, 1, 0)), 0)
+    best_mode = act_mode.max(axis=1)
+    best_idx = np.argmax((act_mode == best_mode[:, None]) & active_s,
+                         axis=1)
+    chosen = np.where(has_stop, stop_idx, best_idx)
+    chosen_mode = act_mode[w, chosen]
 
-    has_preempt = ~has_fit & np.any(preempt_s, axis=1) & valid
-    p_idx = np.argmax(preempt_s, axis=1)
-    preempt_slot0 = np.where(has_preempt, p_idx, -1).astype(np.int32)
-    preempt_borrows0 = borrows_s[w, p_idx] & has_preempt
+    has_fit = (chosen_mode == 2) & valid
+    fit_slot0 = np.where(has_fit, chosen, -1).astype(np.int32)
+    borrows0 = borrows_s[w, chosen] & has_fit
+
+    has_preempt = (chosen_mode == 1) & valid
+    preempt_slot0 = np.where(has_preempt, chosen, -1).astype(np.int32)
+    preempt_borrows0 = borrows_s[w, chosen] & has_preempt
     # per-resource fit on the preempt slot (for frs_need_preemption)
-    preempt_res_fit = fit_r[w, p_idx] | ~relevant[w, p_idx]
-    # how many slots are preempt-capable: with exactly one, the host walk
-    # picks it regardless of the reclaim oracle (the oracle only reorders
-    # among preempt-capable flavors — flavorassigner.go:692 RECLAIM vs
-    # PREEMPT), so the device may fix the slot without running the oracle
-    preempt_slot_count = preempt_s.sum(axis=1).astype(np.int32)
+    preempt_res_fit = fit_r[w, chosen] | ~relevant[w, chosen]
+    # how many attempted slots are preempt-capable: with exactly one, the
+    # host walk picks it regardless of the reclaim oracle (the oracle only
+    # reorders among preempt-capable flavors — flavorassigner.go:692
+    # RECLAIM vs PREEMPT), so the device may fix the slot without running
+    # the oracle; a policy STOP at the slot forces it the same way
+    preempt_slot_count = (preempt_s & active_s).sum(axis=1).astype(np.int32)
+    preempt_stopped0 = has_preempt & has_stop
 
     return {
         "fit_slot0": fit_slot0,
@@ -133,6 +165,7 @@ def classify_np(packed, avail0=None, potential0=None):
         "preempt_borrows0": preempt_borrows0,
         "preempt_res_fit": preempt_res_fit,
         "preempt_slot_count": preempt_slot_count,
+        "preempt_stopped0": preempt_stopped0,
         "avail0": avail0,
         "potential0": potential0,
     }
@@ -397,6 +430,7 @@ def admit_scan_preempt(usage0, subtree, guaranteed, borrow_cap, has_blim,
 def solve_cycle(usage0, subtree, guaranteed, borrow_cap, has_blim, parent,
                 nominal_cq, slot_fr, slot_valid, cq_can_preempt_borrow,
                 wl_cq, wl_requests, wl_priority, wl_timestamp,
+                cq_wcb_borrow=None, cq_wcp_preempt=None, start_slot=None,
                 *, depth: int, run_scan: bool = True):
     """Returns (admitted[W] bool, slot[W] int32, borrows[W] bool,
     preempt_possible[W] bool, fit_slot0[W] int32, borrows0[W] bool).
@@ -404,16 +438,29 @@ def solve_cycle(usage0, subtree, guaranteed, borrow_cap, has_blim, parent,
     Phase 1 classifies each head once against the snapshot usage; the scan
     then admits in cycle order with a fits re-check on the FIXED slot —
     the reference admit-loop semantics (assignments are never recomputed
-    within a cycle).  With ``run_scan=False`` only phase 1 runs."""
+    within a cycle).  With ``run_scan=False`` only phase 1 runs.
+
+    ``cq_wcb_borrow``/``cq_wcp_preempt`` [C] carry the FlavorFungibility
+    policy per CQ and ``start_slot`` [W] the fungibility resume index;
+    omitted, the default policy (whenCanBorrow=Borrow,
+    whenCanPreempt=TryNextFlavor) walks every slot from 0 — the legacy
+    classify surface."""
     C = slot_fr.shape[0]
     W = wl_cq.shape[0]
+    S = slot_fr.shape[1]
+    if cq_wcb_borrow is None:
+        cq_wcb_borrow = jnp.ones(C, dtype=bool)
+    if cq_wcp_preempt is None:
+        cq_wcp_preempt = jnp.zeros(C, dtype=bool)
+    if start_slot is None:
+        start_slot = jnp.zeros(W, dtype=jnp.int32)
 
     avail0 = available_all(usage0, subtree, guaranteed, borrow_cap, has_blim,
                            parent, depth)
     potential0 = available_all(jnp.zeros_like(usage0), subtree, guaranteed,
                                borrow_cap, has_blim, parent, depth)
 
-    def classify(wl_cq_i, req):
+    def classify(wl_cq_i, req, start_i):
         cq = jnp.maximum(wl_cq_i, 0)
         frs = slot_fr[cq]                       # [S, R]
         frs_safe = jnp.maximum(frs, 0)
@@ -440,17 +487,31 @@ def solve_cycle(usage0, subtree, guaranteed, borrow_cap, has_blim, parent,
         borrow_r = jnp.where(relevant, use + req[None, :] > sq, False)
         borrows_s = jnp.any(borrow_r, axis=1) & has_parent   # [S]
 
-        fit_idx = jnp.argmax(fit)
-        has_fit = jnp.any(fit)
-        fit_slot = jnp.where(has_fit, fit_idx, -1)
-        borrows = jnp.where(has_fit, borrows_s[fit_idx], False)
-        preempt_possible = ~has_fit & jnp.any(preempt)
+        # fungibility walk (classify_np twin): stop slots override the
+        # best-mode slot; slots below the resume index are not attempted
+        wcb = cq_wcb_borrow[cq]
+        wcp = cq_wcp_preempt[cq]
+        active = jnp.arange(S) >= start_i                    # [S]
+        stop = active & (fit | (preempt & wcp)) & (~borrows_s | wcb)
+        has_stop = jnp.any(stop)
+        act_mode = jnp.where(active,
+                             jnp.where(fit, 2, jnp.where(preempt, 1, 0)),
+                             0)
+        best_idx = jnp.argmax(act_mode == act_mode.max())
+        chosen = jnp.where(has_stop, jnp.argmax(stop), best_idx)
+        chosen_mode = act_mode[chosen]
+
+        has_fit = chosen_mode == 2
+        fit_slot = jnp.where(has_fit, chosen, -1)
+        borrows = jnp.where(has_fit, borrows_s[chosen], False)
+        preempt_possible = chosen_mode == 1
         valid = wl_cq_i >= 0
         return (jnp.where(valid, fit_slot, -1),
                 borrows & valid,
                 preempt_possible & valid)
 
-    fit_slot0, borrows0, preempt0 = jax.vmap(classify)(wl_cq, wl_requests)
+    fit_slot0, borrows0, preempt0 = jax.vmap(classify)(
+        wl_cq, wl_requests, start_slot)
 
     if not run_scan:
         zeros_b = jnp.zeros(W, dtype=bool)

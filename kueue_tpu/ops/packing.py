@@ -71,6 +71,8 @@ class PackedStructure:
     slot_valid: np.ndarray               # [C, S] bool
     slot_count_cq: np.ndarray            # [C] int32: len(rg.flavors)
     cq_can_preempt_borrow: np.ndarray    # [C] bool
+    cq_wcb_borrow: np.ndarray            # [C] bool: whenCanBorrow == Borrow
+    cq_wcp_preempt: np.ndarray           # [C] bool: whenCanPreempt == Preempt
     fair_weight_milli: np.ndarray        # [N] int32
     forest_of_node: np.ndarray           # [N] int32
     n_forests: int
@@ -123,6 +125,10 @@ class PackedCycle:
     def slot_valid(self): return self.structure.slot_valid
     @property
     def cq_can_preempt_borrow(self): return self.structure.cq_can_preempt_borrow
+    @property
+    def cq_wcb_borrow(self): return self.structure.cq_wcb_borrow
+    @property
+    def cq_wcp_preempt(self): return self.structure.cq_wcp_preempt
     @property
     def fair_weight_milli(self): return self.structure.fair_weight_milli
     @property
@@ -384,13 +390,22 @@ def pack_structure(snapshot: Snapshot, heads: list[Info] = (),
     slot_valid = np.zeros((C, S), dtype=bool)
     slot_count = np.zeros(C, dtype=np.int32)
     cq_can_preempt_borrow = np.zeros(C, dtype=bool)
-    from ..api.types import BorrowWithinCohortPolicy, ReclaimWithinCohort
+    cq_wcb_borrow = np.zeros(C, dtype=bool)
+    cq_wcp_preempt = np.zeros(C, dtype=bool)
+    from ..api.types import (BorrowWithinCohortPolicy,
+                             FlavorFungibilityPolicy, ReclaimWithinCohort)
     for ci, name in enumerate(cq_names):
-        p = snapshot.cluster_queues[name].spec.preemption
+        spec = snapshot.cluster_queues[name].spec
+        p = spec.preemption
         cq_can_preempt_borrow[ci] = (
             p.borrow_within_cohort.policy != BorrowWithinCohortPolicy.NEVER
             or (snapshot_fair_sharing(snapshot)
                 and p.reclaim_within_cohort != ReclaimWithinCohort.NEVER))
+        ff = spec.flavor_fungibility
+        cq_wcb_borrow[ci] = (
+            ff.when_can_borrow == FlavorFungibilityPolicy.BORROW)
+        cq_wcp_preempt[ci] = (
+            ff.when_can_preempt == FlavorFungibilityPolicy.PREEMPT)
     for ci, name in enumerate(cq_names):
         cq = snapshot.cluster_queues[name]
         for rg in cq.spec.resource_groups:
@@ -415,6 +430,7 @@ def pack_structure(snapshot: Snapshot, heads: list[Info] = (),
         nominal_plus_blimit_cq=nominal_plus_blimit,
         slot_fr=slot_fr, slot_valid=slot_valid, slot_count_cq=slot_count,
         cq_can_preempt_borrow=cq_can_preempt_borrow,
+        cq_wcb_borrow=cq_wcb_borrow, cq_wcp_preempt=cq_wcp_preempt,
         fair_weight_milli=fair_weight, forest_of_node=forest_of_node,
         n_forests=n_forests, cq_index=cq_idx, cq_covers_pods=cq_covers_pods,
     )

@@ -39,7 +39,7 @@ from typing import Optional
 
 import numpy as np
 
-from ..api.types import FlavorFungibility
+from ..api.types import FlavorFungibility, FlavorFungibilityPolicy
 from ..cache.snapshot import Snapshot
 from ..workload import Info, Ordering
 from ..scheduler.flavorassigner import (
@@ -81,6 +81,10 @@ class ClassifiedCycle:
     preempt_borrows0: np.ndarray  # [W] bool
     preempt_res_fit: np.ndarray  # [W, R] bool
     preempt_slot_count: np.ndarray = None  # [W] int32 preempt-capable slots
+    preempt_stopped0: np.ndarray = None    # [W] bool: the fungibility walk
+                                           # policy-stopped ON the preempt
+                                           # slot (choice is final — no
+                                           # reclaim-oracle dependence)
     # heads the vectorized math can't classify: the scheduler runs the
     # host FlavorAssigner walk for these and attaches the assignment
     scalar_mask: np.ndarray = None         # [W] bool
@@ -178,6 +182,14 @@ class CycleSolver:
             "structure_rebuilds": 0,
             "calibration_loaded": 0,  # router table reloaded from disk
             "scalar_heads": 0,        # heads classified by the host walk
+            # flavor-walk telemetry (heterogeneous fast path):
+            "scalar_reasons": {},     # {reason: count} for scalar heads
+            "resume_heads": 0,        # heads entering the walk mid-list
+            "walk_stop_heads": 0,     # heads whose walk policy-stopped
+            "native_ff_fallbacks": 0,  # native classify skipped: the C++
+                                       # core is first-fit-only and the
+                                       # cycle has non-default fungibility
+                                       # or a resumed head
         }
         self._structure: Optional[PackedStructure] = None
         self._potential0 = None
@@ -593,18 +605,16 @@ class CycleSolver:
     def _cq_vector_ok(self, snapshot: Snapshot,
                       st: PackedStructure) -> np.ndarray:
         """Per-CQ: can the vectorized classify reproduce the host flavor
-        walk for heads of this CQ?  Requires a single resource group,
-        default fungibility, and plain flavors (existing, no taints, no
-        node labels, no topology) — everything else routes the head to
-        the scalar host walk instead (flavorassigner.go:499-640)."""
+        walk for heads of this CQ?  Requires a single resource group and
+        plain flavors (existing, no taints, no node labels, no topology)
+        — everything else routes the head to the scalar host walk instead
+        (flavorassigner.go:499-640).  Any FlavorFungibility policy is
+        fine: the walk (stop rules + resume index) runs in the vector
+        math itself (classify_np / the fused burst kernel)."""
         ok = np.zeros(len(st.cq_names), dtype=bool)
         for ci, name in enumerate(st.cq_names):
             cq = snapshot.cluster_queues[name]
             if len(cq.spec.resource_groups) != 1:
-                continue
-            ff = cq.spec.flavor_fungibility
-            if (ff.when_can_borrow != _DEFAULT_FF.when_can_borrow
-                    or ff.when_can_preempt != _DEFAULT_FF.when_can_preempt):
                 continue
             plain = True
             for rg in cq.spec.resource_groups:
@@ -620,33 +630,44 @@ class CycleSolver:
     def _scalar_mask(self, snapshot: Snapshot, heads: list[Info],
                      st: PackedStructure) -> np.ndarray:
         """Per-head: True → the head needs the scalar host walk (the
-        vectorized classify's assumptions don't hold)."""
+        vectorized classify's assumptions don't hold).  A mid-list
+        fungibility resume state is NOT a scalar reason anymore: it
+        becomes the head's vector start slot (``resume_start``)."""
         mask = np.zeros(len(heads), dtype=bool)
         cq_ok = st.cq_vector_ok
+        reasons = self.stats["scalar_reasons"]
         for wi, h in enumerate(heads):
             ci = st.cq_index.get(h.cluster_queue, -1)
             if ci < 0 or not cq_ok[ci]:
                 mask[wi] = True
+                reasons["cq_shape"] = reasons.get("cq_shape", 0) + 1
                 continue
             if len(h.obj.pod_sets) != 1:
                 # the host can split flavors across pod sets and accounts
                 # earlier pod sets' usage in later walks
                 mask[wi] = True
+                reasons["multi_podset"] = reasons.get("multi_podset", 0) + 1
                 continue
             ps = h.obj.pod_sets[0]
             if ps.topology_request is not None:
                 mask[wi] = True
-                continue
-            last = h.last_assignment
-            if last is not None and last.pending_flavors:
-                # effective fungibility resume state: the host starts the
-                # flavor walk mid-list (flavorassigner.go:359-366); the
-                # vector classify always scans from slot 0
-                cq = snapshot.cq(h.cluster_queue)
-                if (cq is not None and
-                        last.cluster_queue_generation >= cq.allocatable_generation):
-                    mask[wi] = True
+                reasons["topology"] = reasons.get("topology", 0) + 1
         return mask
+
+    def _start_slots(self, snapshot: Snapshot, heads: list[Info],
+                     st: PackedStructure) -> np.ndarray:
+        """Per-head flavor-walk start slot from the fungibility resume
+        state (flavorassigner.go:359-366): a head whose last attempt
+        stopped mid-list resumes at last_tried_flavor_idx + 1, unless the
+        CQ's quota changed since (allocatable_generation moved on)."""
+        start = np.zeros(len(heads), dtype=np.int32)
+        for wi, h in enumerate(heads):
+            s = resume_start(h, snapshot.cq(h.cluster_queue),
+                             h.cluster_queue in st.cq_covers_pods)
+            if s:
+                start[wi] = s
+                self.stats["resume_heads"] += 1
+        return start
 
     # -- phase 1 -------------------------------------------------------
 
@@ -673,17 +694,26 @@ class CycleSolver:
             # lossy int32 scaling could deny fits the host grants
             return None
         scalar = self._scalar_mask(snapshot, heads, st)
+        start = self._start_slots(snapshot, heads, st)
         if self._potential0 is None or self._potential0.shape != packed.usage0.shape:
             from .cycle import available_all_np
             self._potential0 = available_all_np(
                 np.zeros_like(packed.usage0), st.subtree_quota, st.guaranteed,
                 st.borrow_cap, st.has_borrow_limit, st.parent, st.depth)
 
-        if self.backend == "native":
+        W = packed.wl_cq.shape[0]
+        start_pad = np.zeros(W, dtype=np.int32)
+        start_pad[:len(heads)] = start
+        # the C++ classify core is first-fit-only: any non-default
+        # fungibility policy or mid-list resume routes to classify_np
+        ff_default = (bool(st.cq_wcb_borrow.all())
+                      and not bool(st.cq_wcp_preempt.any()))
+        if self.backend == "native" and (not ff_default or start.any()):
+            self.stats["native_ff_fallbacks"] += 1
+        if self.backend == "native" and ff_default and not start.any():
             from .. import native
             fit_slot0, borrows0, preempt0 = native.classify_cycle(packed)
             n = packed.wl_count
-            W = packed.wl_cq.shape[0]
             R = len(st.resource_names)
             out = {
                 "fit_slot0": np.asarray(fit_slot0),
@@ -693,18 +723,20 @@ class CycleSolver:
                 "preempt_borrows0": np.zeros(W, bool),
                 "preempt_res_fit": np.ones((W, R), bool),
                 "preempt_slot_count": np.zeros(W, np.int32),
+                "preempt_stopped0": np.zeros(W, bool),
             }
             if out["preempt0"][:n].any():
                 # the C++ core covers fit/borrow/preempt-possible; the
                 # preempt-slot details come from the numpy pass on demand
                 det = classify_np(packed, potential0=self._potential0)
                 for k in ("preempt_slot0", "preempt_borrows0",
-                          "preempt_res_fit", "preempt_slot_count"):
+                          "preempt_res_fit", "preempt_slot_count",
+                          "preempt_stopped0"):
                     out[k] = det[k]
         else:
-            out = classify_np(packed, potential0=self._potential0)
+            out = classify_np(packed, potential0=self._potential0,
+                              start_slot=start_pad)
         n = packed.wl_count
-        W = packed.wl_cq.shape[0]
         # partial admission: a min_count head whose FULL counts fit is
         # decision-identical to a plain head; otherwise the host runs the
         # PodSetReducer binary search (podset_reducer.go) — scalar walk
@@ -725,9 +757,12 @@ class CycleSolver:
             out["preempt0"] = out["preempt0"] & ~sm
             out["preempt_slot0"] = np.where(sm, -1, out["preempt_slot0"]).astype(np.int32)
             out["preempt_borrows0"] = out["preempt_borrows0"] & ~sm
+            out["preempt_stopped0"] = out["preempt_stopped0"] & ~sm
             self.stats["scalar_heads"] += int(scalar.sum())
         else:
             sm = np.zeros(W, dtype=bool)
+        self.stats["walk_stop_heads"] += int(
+            np.count_nonzero(out["preempt_stopped0"][:n]))
         return ClassifiedCycle(
             packed=packed, heads=heads, snapshot=snapshot,
             fit_slot0=out["fit_slot0"], borrows0=out["borrows0"],
@@ -735,6 +770,7 @@ class CycleSolver:
             preempt_borrows0=out["preempt_borrows0"],
             preempt_res_fit=out["preempt_res_fit"],
             preempt_slot_count=out["preempt_slot_count"],
+            preempt_stopped0=out["preempt_stopped0"],
             scalar_mask=sm, host_assignments={}, host_pairs={})
 
     # -- scalar-head decisions -----------------------------------------
@@ -1281,15 +1317,26 @@ def build_slot_assignment(info: Info, cq, slot: int, mode: Mode,
                           res_modes: Optional[dict] = None) -> Assignment:
     """Reconstruct the host Assignment a device-classified head would get
     from the flavor walk: single resource group, slot = flavor index,
-    including the fungibility resume state (flavorassigner.go:499 under
-    default fungibility).  ``cq`` is any CQState (snapshot or live cache)
-    carrying .spec and .allocatable_generation."""
+    including the fungibility resume state (flavorassigner.go:499).
+    ``cq`` is any CQState (snapshot or live cache) carrying .spec and
+    .allocatable_generation."""
     slot = int(slot)
     rg = cq.spec.resource_groups[0]
     covers_pods = "pods" in rg.covered_resources
     flavor_name = rg.flavors[slot].name
     n_slots = len(rg.flavors)
-    tried = -1 if slot == n_slots - 1 else slot
+    # the host records attempted_idx = the slot the walk STOPPED on, or
+    # the last slot when it scanned to the end and kept the best
+    # (flavorassigner.go:386-390 + shouldTryNextFlavor); tried = -1 when
+    # the whole list was attempted
+    ff = cq.spec.flavor_fungibility
+    wcb = ff.when_can_borrow == FlavorFungibilityPolicy.BORROW
+    wcp = ff.when_can_preempt == FlavorFungibilityPolicy.PREEMPT
+    stopped = ((not borrow or wcb)
+               and (mode == Mode.FIT
+                    or (mode == Mode.PREEMPT and wcp)))
+    attempted = slot if stopped else n_slots - 1
+    tried = -1 if attempted == n_slots - 1 else attempted
 
     assignment = Assignment()
     assignment.borrowing = borrow
@@ -1319,3 +1366,29 @@ def build_slot_assignment(info: Info, cq, slot: int, mode: Mode,
         assignment.pod_sets.append(ps_res)
         assignment.last_state.last_tried_flavor_idx.append(flavor_idx)
     return assignment
+
+
+def resume_start(info: Info, cq, covers_pods: bool) -> int:
+    """Flavor-walk start slot for a head with fungibility resume state.
+
+    Mirrors the host's entry into the walk (flavorassigner.go:359-366 via
+    next_flavor_to_try of the first resource in sorted request order): 0
+    when there is no usable resume state, last_tried + 1 otherwise.  The
+    state is void when the CQ's quota changed since it was recorded
+    (assign() clears it on allocatable_generation advance)."""
+    last = info.last_assignment
+    if last is None or cq is None:
+        return 0
+    if cq.allocatable_generation > last.cluster_queue_generation:
+        return 0
+    if not info.total_requests:
+        return 0
+    psr = info.total_requests[0]
+    reqs = set(psr.requests)
+    if covers_pods:
+        reqs.add("pods")
+    else:
+        reqs.discard("pods")
+    if not reqs:
+        return 0
+    return max(0, int(last.next_flavor_to_try(0, sorted(reqs)[0])))

@@ -221,11 +221,12 @@ _I32_MAX = np.int32(2**31 - 1)
 _C_FILLS = {
     "wl_req": 0, "wl_rank": _I32_MAX, "wl_cycle_rank": 0, "wl_prio": 0,
     "wl_uidrank": 0, "vec_ok": False,
-    "elig0": False, "parked0": False, "resume0": False, "adm0": False,
+    "elig0": False, "parked0": False, "resume0": 0, "adm0": False,
     "adm_seq0": 0, "adm_usage0": 0, "adm_uses0": False,
     "death0": _I32_MAX, "u_cq0": 0,
     "nominal_cq": 0, "npb_cq": 0, "slot_fr": -1, "slot_valid": False,
     "cq_can_preempt_borrow": False, "strict_cq": False,
+    "cq_wcb_borrow": True, "cq_wcp_preempt": False,
     "wcq_lower": False, "rwc_enabled": False, "rwc_only_lower": False,
     "preempt_ok": False, "self_lmem": 0,
 }
@@ -233,7 +234,7 @@ _N_FILLS = {
     "potential0": 0, "subtree": 0, "guaranteed": 0, "borrow_cap": 0,
     "has_blim": False,
 }
-_STATE_FILLS = (False, False, False, False, 0, 0, False, _I32_MAX, 0)
+_STATE_FILLS = (False, False, 0, False, 0, 0, False, _I32_MAX, 0)
 _STATE_NAMES = ("elig0", "parked0", "resume0", "adm0", "adm_seq0",
                 "adm_usage0", "adm_uses0", "death0", "u_cq0")
 
@@ -253,7 +254,8 @@ _STATE_NAMES = ("elig0", "parked0", "resume0", "adm0", "adm_seq0",
 #   (preempt_ok depends on global scalars).  Always re-uploaded; all
 #   are small relative to the row tier.
 _ROW_STATIC = ("nominal_cq", "npb_cq", "slot_fr", "slot_valid",
-               "cq_can_preempt_borrow", "wcq_lower", "rwc_enabled",
+               "cq_can_preempt_borrow", "cq_wcb_borrow",
+               "cq_wcp_preempt", "wcq_lower", "rwc_enabled",
                "rwc_only_lower", "self_lmem")
 SCATTER_PLANES = ("wl_req", "wl_rank", "wl_prio", "vec_ok", "strict_cq",
                   "elig0", "parked0", "resume0", "adm0", "adm_usage0",
@@ -501,7 +503,7 @@ def sharded_burst_fn(mesh: Mesh, *, K: int, depth: int, L: int, S: int,
     row = P("cq")
     rep = P()
     kc = P(None, "cq")
-    in_specs = (row,) * 14 + (rep,) + (row,) * 23 + (kc, kc)
+    in_specs = (row,) * 14 + (rep,) + (row,) * 25 + (kc, kc)
     out_specs = (kc, kc, kc, kc, kc, rep, rep, (row,) * 9)
     body = _partial(_burst_cycles, K=K, depth=depth, L=L, S=S, KC=KC,
                     n_levels=n_levels, G=G, runtime=runtime,

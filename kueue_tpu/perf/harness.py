@@ -342,6 +342,31 @@ class MissingControlArm(ValueError):
     """An A/B block was requested without an interleaved control arm."""
 
 
+# Host-fallback visibility for published A/B arms: any of these present
+# in an arm's solver/burst stat blocks is copied into the block's
+# environment_drift record, so a "device wins" artifact also proves how
+# much of the arm actually ran on the device.
+_FALLBACK_KEYS = ("host_cycles", "scalar_heads", "resume_heads",
+                  "walk_stop_heads", "native_ff_fallbacks",
+                  "burst_dirty_cycles", "burst_dirty_preempt",
+                  "burst_dirty_scalar", "burst_dirty_resume",
+                  "burst_suppressed_cycles")
+
+
+def _fallback_counters(arm: dict) -> dict:
+    out: dict = {}
+    for src_key in ("solver_stats", "flavor_walk", "burst_stats"):
+        src = arm.get(src_key)
+        if isinstance(src, dict):
+            for k in _FALLBACK_KEYS:
+                if k in src:
+                    out[k] = src[k]
+    for k in _FALLBACK_KEYS:       # counters may also sit at top level
+        if k in arm:
+            out[k] = arm[k]
+    return out
+
+
 def ab_block(treatment: dict, control: dict | None, *,
              treatment_label: str = "treatment",
              control_label: str = "control") -> dict:
@@ -362,7 +387,12 @@ def ab_block(treatment: dict, control: dict | None, *,
             "measured before/after the treatment (not interleaved with "
             "it) does not bound environment drift")
     return {treatment_label: dict(treatment),
-            control_label: dict(control)}
+            control_label: dict(control),
+            "environment_drift": {
+                "interleaved": True,
+                "fallback_counters": {
+                    treatment_label: _fallback_counters(treatment),
+                    control_label: _fallback_counters(control)}}}
 
 
 def check_rangespec(stats: PerfStats, rangespec: dict) -> list[str]:

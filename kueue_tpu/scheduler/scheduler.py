@@ -469,10 +469,12 @@ class Scheduler:
             batch_reqs: list[tuple[int, Assignment]] = []
             for wi in np.nonzero(cls.preempt0[:n])[0]:
                 wi = int(wi)
-                # With several preempt-capable slots the host walk's choice
-                # depends on the reclaim oracle (flavorassigner.go:692
+                # A policy-stopped preempt choice is final; otherwise with
+                # several preempt-capable slots the host walk's best-mode
+                # pick depends on the reclaim oracle (flavorassigner.go:692
                 # RECLAIM beats PREEMPT) — run the real walk for this head.
-                if cls.preempt_slot_count[wi] != 1:
+                if not (cls.preempt_stopped0[wi]
+                        or cls.preempt_slot_count[wi] == 1):
                     if not scalar_walk(wi):
                         full_ok = False
                         break

@@ -83,6 +83,8 @@ if _shards > 1:
 from kueue_tpu.api.types import (
     ClusterQueue,
     FairSharing,
+    FlavorFungibility,
+    FlavorFungibilityPolicy,
     FlavorQuotas,
     LocalQueue,
     PodSet,
@@ -95,6 +97,19 @@ from kueue_tpu.api.types import (
     Workload,
 )
 from kueue_tpu.controller.driver import Driver
+
+# heterogeneous runs cycle the whenCanBorrow x whenCanPreempt matrix
+# across CQs so the in-kernel fungibility walk sees every policy shape
+FF_MIX = [
+    FlavorFungibility(),                                  # Borrow/TryNext
+    FlavorFungibility(
+        when_can_borrow=FlavorFungibilityPolicy.TRY_NEXT_FLAVOR),
+    FlavorFungibility(
+        when_can_preempt=FlavorFungibilityPolicy.PREEMPT),
+    FlavorFungibility(
+        when_can_borrow=FlavorFungibilityPolicy.TRY_NEXT_FLAVOR,
+        when_can_preempt=FlavorFungibilityPolicy.PREEMPT),
+]
 
 
 class VirtualClock:
@@ -123,6 +138,8 @@ def build(n_cqs: int, n_wl: int, use_device: bool, cqs_per_cohort: int = 5,
         # (flavorassigner.go:499) has to visit most of the list
         d.apply_cluster_queue(ClusterQueue(
             name=f"cq-{i}", cohort=cohort,
+            flavor_fungibility=(FF_MIX[i % len(FF_MIX)]
+                                if n_flavors > 1 else FlavorFungibility()),
             preemption=PreemptionPolicy(
                 reclaim_within_cohort=ReclaimWithinCohort.ANY,
                 within_cluster_queue=WithinClusterQueue.LOWER_PRIORITY),
@@ -565,10 +582,22 @@ def run_path(args, use_device: bool) -> dict:
               file=sys.stderr)
 
     inject_at = args.inject_at if args.inject_at >= 0 else args.cycles // 3
+    budget_s = float(getattr(args, "budget_s", 0.0) or 0.0)
+    completed = True
+    # same GC discipline as run_burst_path: collection paused for the
+    # measured phase on every arm equally (period-3 gen collections
+    # otherwise inject 0.5-1.1s pauses that grow with the run)
+    gc.disable()
+    t_run0 = time.perf_counter()
     cycle_times = []
     admitted_total = preempted_total = skipped_total = 0
     running = []
     for cycle in range(args.cycles):
+        if budget_s and time.perf_counter() - t_run0 > budget_s:
+            completed = False
+            print(f"budget {budget_s:.0f}s exhausted after "
+                  f"{cycle}/{args.cycles} cycles", file=sys.stderr)
+            break
         if cycle == inject_at:
             n = preemptor_wave(clock.t)
             total += n
@@ -600,6 +629,7 @@ def run_path(args, use_device: bool) -> dict:
               f"skipped={len(stats.skipped)} "
               f"inadmissible={len(stats.inadmissible)}", file=sys.stderr)
 
+    gc.enable()
     cycle_times.sort()
     p50 = cycle_times[len(cycle_times) // 2]
     p99 = cycle_times[min(len(cycle_times) - 1,
@@ -613,7 +643,12 @@ def run_path(args, use_device: bool) -> dict:
         "preempted": preempted_total,
         "skipped": skipped_total,
         "workloads": total,
+        "cycles_run": len(cycle_times),
+        "completed": completed,
     }
+    if budget_s:
+        out["budget_s"] = budget_s
+        out["elapsed_s"] = round(time.perf_counter() - t_run0, 1)
     if solver is not None:
         out["solver_stats"] = dict(solver.stats)
         if solver.rtt_s is not None:
@@ -704,6 +739,13 @@ def main():
                          "across N devices (same as KUEUE_TPU_SHARDS=N; "
                          "on a CPU host this also forces "
                          "--xla_force_host_platform_device_count=N)")
+    ap.add_argument("--ab-hetero", action="store_true",
+                    help="heterogeneous A/B: the in-kernel fungibility "
+                         "per-cycle arm, the fused burst arm (plus an "
+                         "--ab-shards arm when set) INTERLEAVED with "
+                         "the host-walk oracle; emits a 'hetero' block "
+                         "with fallback counters and cross-arm "
+                         "decision identity")
     ap.add_argument("--ab-shards", type=int, default=0,
                     help="run serial and N-shard burst trials "
                          "INTERLEAVED in one process (drift-fair A/B) "
@@ -724,9 +766,18 @@ def main():
                          "reachable instead of producing CPU-only "
                          "numbers; also makes the accel smoke test "
                          "FAIL rather than skip")
+    ap.add_argument("--quick", action="store_true",
+                    help="seconds-level smoke sizing (CI wiring check, "
+                         "not a perf number): caps cqs/wl/cycles and "
+                         "runs one trial per arm")
     ap.add_argument("--out", default=None,
                     help="also write the JSON tail to this file")
     args = ap.parse_args()
+    if args.quick:
+        args.cqs = min(args.cqs, 12)
+        args.wl = min(args.wl, 240)
+        args.cycles = min(args.cycles, 12)
+        args.trials = 1
 
     if args.require_accel:
         from kueue_tpu.perf.harness import require_accel_or_die
@@ -737,7 +788,123 @@ def main():
     results = []
     shard_compare = None
     crossover = None
-    if args.burst and args.crossover:
+    hetero = None
+    if args.burst and args.ab_hetero:
+        # drift-fair heterogeneous A/B: the in-kernel fungibility arms
+        # (per-cycle device solver — the headline p99 treatment, since
+        # its cycle boundaries attribute cost exactly like the host
+        # control's — plus the fused serial burst and an optional
+        # --ab-shards arm) interleaved with the host-walk oracle in one
+        # process; decisions must be bit-identical across every
+        # completed arm
+        from kueue_tpu.perf.harness import ab_block
+        backend = ("cpu" if args.burst_backend == "both"
+                   else args.burst_backend)
+        shard_n = args.ab_shards if args.ab_shards > 1 else 0
+        runs = {"in_kernel": [], "burst": [], "host": []}
+        if shard_n:
+            runs["sharded"] = []
+        for _ in range(max(1, args.trials)):
+            args.shards = 0
+            runs["in_kernel"].append(run_path(args, use_device=True))
+            gc.unfreeze()
+            gc.collect()
+            runs["burst"].append(run_burst_path(args, backend=backend))
+            gc.unfreeze()
+            gc.collect()
+            if shard_n:
+                args.shards = shard_n
+                runs["sharded"].append(run_burst_path(args,
+                                                      backend=backend))
+                args.shards = 0
+                gc.unfreeze()
+                gc.collect()
+            runs["host"].append(run_path(args, use_device=False))
+            gc.unfreeze()
+            gc.collect()
+        sums = {k: summarize_trials(v) for k, v in runs.items()}
+        results.append(sums["in_kernel"])
+        results.append(sums["burst"])
+        if shard_n:
+            results.append(sums["sharded"])
+        results.append(sums["host"])
+        ik, bu, ho = sums["in_kernel"], sums["burst"], sums["host"]
+        # fallback counters are merged across every device-resident
+        # arm — the zero-host-fallback claim covers all of them
+        device_arms = [ik, bu] + ([sums["sharded"]] if shard_n else [])
+        sstats = [a.get("solver_stats", {}) for a in device_arms]
+        bs_ = bu.get("burst_stats", {})
+        reasons = {}
+        for ss in sstats:
+            for k, v in ss.get("scalar_reasons", {}).items():
+                reasons[k] = reasons.get(k, 0) + v
+        done = [r for arm in runs.values() for r in arm
+                if r.get("completed", True)]
+        identical = bool(done) and all(
+            (r["admitted"], r["preempted"], r["skipped"]) ==
+            (done[0]["admitted"], done[0]["preempted"],
+             done[0]["skipped"]) for r in done)
+        fallbacks = {
+            "host_cycles": sum(s.get("host_cycles", 0) for s in sstats),
+            "scalar_heads": sum(s.get("scalar_heads", 0)
+                                for s in sstats),
+            "scalar_reasons": reasons,
+            "native_ff_fallbacks": sum(s.get("native_ff_fallbacks", 0)
+                                       for s in sstats),
+            "burst_dirty_cycles": bs_.get("burst_dirty_cycles", 0),
+            "burst_dirty_preempt": bs_.get("burst_dirty_preempt", 0),
+            "burst_dirty_scalar": bs_.get("burst_dirty_scalar", 0),
+            "burst_dirty_resume": bs_.get("burst_dirty_resume", 0),
+        }
+        ss = ik.get("solver_stats", {})
+        hetero = {
+            "flavors": args.flavors,
+            "resources": args.resources,
+            "fungibility_mix": "whenCanBorrow x whenCanPreempt matrix "
+                               "cycled across CQs (4 combos)",
+            "fallbacks": fallbacks,
+            "zero_host_fallbacks": (fallbacks["host_cycles"] == 0
+                                    and fallbacks["scalar_heads"] == 0),
+            "resume_heads": sum(s.get("resume_heads", 0)
+                                for s in sstats),
+            "walk_stop_heads": sum(s.get("walk_stop_heads", 0)
+                                   for s in sstats),
+            "p50_ms_in_kernel": ik["p50_ms"],
+            "p50_ms_host": ho["p50_ms"],
+            "p99_ms_in_kernel": ik["p99_ms"],
+            "p99_ms_host": ho["p99_ms"],
+            "in_kernel_beats_host_p99": ik["p99_ms"] < ho["p99_ms"],
+            "decisions_identical_across_arms": identical,
+            "burst_arm": {
+                "p50_ms": bu["p50_ms"], "p99_ms": bu["p99_ms"],
+                "completed": bu.get("completed", True),
+                "burst_dirty_cycles": bs_.get("burst_dirty_cycles", 0),
+                "burst_suppressed_cycles": bs_.get(
+                    "burst_suppressed_cycles", 0)},
+            "drift": ab_block(
+                treatment={"arm": ik["path"], "p99_ms": ik["p99_ms"],
+                           "solver_stats": {
+                               k: v for k, v in ss.items()
+                               if not isinstance(v, dict)},
+                           "burst_stats": {
+                               k: bs_.get(k, 0)
+                               for k in ("burst_dirty_cycles",
+                                         "burst_dirty_preempt",
+                                         "burst_dirty_scalar",
+                                         "burst_dirty_resume",
+                                         "burst_suppressed_cycles")}},
+                control={"arm": "host", "interleaved": True,
+                         "p99_ms": ho["p99_ms"],
+                         "cycles_run": ho.get("cycles_run", 0)},
+                treatment_label="in_kernel",
+                control_label="host_fallback"),
+        }
+        if shard_n:
+            sh = sums["sharded"]
+            hetero["shard_arm"] = {
+                "shards": shard_n, "p99_ms": sh["p99_ms"],
+                "completed": sh.get("completed", True)}
+    elif args.burst and args.crossover:
         # the shard crossover curve: every arm (single-device serial
         # control included) runs back to back inside each trial block,
         # so machine drift lands on all arms equally; each arm's p99
@@ -923,7 +1090,7 @@ def main():
     if not args.host and not args.burst and not args.fair_sharing:
         results.append(with_trials(
             lambda: run_path(args, use_device=True), args))
-    if not args.device and not args.fair_sharing:
+    if not args.device and not args.fair_sharing and not args.ab_hetero:
         results.append(with_trials(
             lambda: run_path(args, use_device=False), args))
     mesh_shards = max(args.shards, args.ab_shards,
@@ -935,8 +1102,12 @@ def main():
         "flavors": args.flavors, "resources": args.resources,
         "mesh": mesh_info(mesh_shards),
     }
+    if args.quick:
+        tail["quick"] = True
     if shard_compare is not None:
         tail["shard_compare"] = shard_compare
+    if hetero is not None:
+        tail["hetero"] = hetero
     if crossover is not None:
         tail["crossover"] = crossover
         # the mesh block is the self-describing home for shard-health

@@ -180,6 +180,60 @@ def _check_crossover(label, c, path, out):
              "'decisions_identical_across_arms'")
 
 
+def _check_hetero(d, path, out):
+    """NORTHSTAR heterogeneous artifacts (scripts/northstar_e2e.py
+    --ab-hetero): the in-kernel fungibility arm's fallback counters, the
+    zero-host-fallback verdict consistent with them, cross-arm decision
+    identity, the p99 comparison against the interleaved host oracle,
+    and the environment-drift block with its fallback-counter record."""
+    h = d.get("hetero")
+    if not isinstance(h, dict):
+        _err(out, path, "'hetero' must be an object")
+        return
+    for k in ("flavors", "resources"):
+        if not isinstance(h.get(k), int) or h[k] < 1:
+            _err(out, path, f"'hetero.{k}' must be an int >= 1")
+    if isinstance(h.get("flavors"), int) and h["flavors"] < 2:
+        _err(out, path, "'hetero.flavors' must be >= 2 (a single-flavor "
+             "run has no fungibility walk to measure)")
+    fb = h.get("fallbacks")
+    if not isinstance(fb, dict):
+        _err(out, path, "'hetero.fallbacks' must be an object")
+        fb = {}
+    else:
+        for k in ("host_cycles", "scalar_heads", "native_ff_fallbacks",
+                  "burst_dirty_cycles", "burst_dirty_preempt",
+                  "burst_dirty_scalar", "burst_dirty_resume"):
+            if not isinstance(fb.get(k), int):
+                _err(out, path, f"'hetero.fallbacks.{k}' must be an int")
+    zero = h.get("zero_host_fallbacks")
+    if not isinstance(zero, bool):
+        _err(out, path, "'hetero' missing bool 'zero_host_fallbacks'")
+    elif isinstance(fb.get("host_cycles"), int) \
+            and isinstance(fb.get("scalar_heads"), int) \
+            and zero != (fb["host_cycles"] == 0
+                         and fb["scalar_heads"] == 0):
+        _err(out, path, f"'hetero.zero_host_fallbacks'={zero} "
+             "inconsistent with the fallback counters")
+    for k in ("decisions_identical_across_arms",
+              "in_kernel_beats_host_p99"):
+        if not isinstance(h.get(k), bool):
+            _err(out, path, f"'hetero' missing bool '{k}'")
+    for k in ("p99_ms_in_kernel", "p99_ms_host"):
+        if not isinstance(h.get(k), (int, float)):
+            _err(out, path, f"'hetero' missing numeric '{k}'")
+    drift = h.get("drift")
+    if not isinstance(drift, dict):
+        _err(out, path, "'hetero.drift' must be an object (see "
+             "perf/harness.ab_block)")
+    else:
+        env = drift.get("environment_drift")
+        if not isinstance(env, dict) or env.get("interleaved") is not True \
+                or not isinstance(env.get("fallback_counters"), dict):
+            _err(out, path, "'hetero.drift.environment_drift' must carry "
+                 "interleaved=true and a 'fallback_counters' object")
+
+
 def _check_traffic(d, path, out):
     """TRAFFIC_* open-loop artifacts (scripts/traffic_soak.py): the
     arrival-process parameters, the SLO, per-arm sustainable-rate
@@ -266,6 +320,15 @@ def validate(path: str) -> list[str]:
     m = re.match(r"MULTICHIP_R(\d+)", base)
     if base.startswith(_STRICT_PREFIXES) or (m and int(m.group(1)) >= 8):
         _check_metric_value(d, path, out)
+    # by name or by shape: the heterogeneous fast-path tier applies to
+    # any artifact carrying a 'hetero' block, and NORTHSTAR_r12+ must
+    # carry one (the mixed-fleet scenario is the north star from r12 on)
+    ns = re.match(r"NORTHSTAR_R(\d+)", base)
+    if "hetero" in d:
+        _check_hetero(d, path, out)
+    elif ns and int(ns.group(1)) >= 12:
+        _err(out, path, "NORTHSTAR_r12+ artifacts must carry a "
+             "'hetero' block")
     blocks = _crossover_blocks(d)
     for label, c in blocks:
         _check_crossover(label, c, path, out)

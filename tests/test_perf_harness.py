@@ -82,4 +82,27 @@ def test_ab_block_requires_interleaved_control():
         ab_block(treatment, {"arm": "serial", "p99_ms": 15.0})
     relabeled = ab_block(treatment, control, treatment_label="sharded",
                          control_label="serial_control")
-    assert set(relabeled) == {"sharded", "serial_control"}
+    assert set(relabeled) == {"sharded", "serial_control",
+                              "environment_drift"}
+
+
+def test_ab_block_records_fallback_counters():
+    from kueue_tpu.perf.harness import ab_block
+
+    treatment = {"arm": "burst", "p99_ms": 12.0,
+                 "solver_stats": {"host_cycles": 0, "scalar_heads": 0,
+                                  "native_ff_fallbacks": 2},
+                 "burst_stats": {"burst_dirty_cycles": 0,
+                                 "burst_dispatches": 9}}
+    control = {"arm": "host", "p99_ms": 40.0, "interleaved": True,
+               "host_cycles": 30}
+    block = ab_block(treatment, control)
+    drift = block["environment_drift"]
+    assert drift["interleaved"] is True
+    fc = drift["fallback_counters"]
+    assert fc["treatment"]["host_cycles"] == 0
+    assert fc["treatment"]["native_ff_fallbacks"] == 2
+    assert fc["treatment"]["burst_dirty_cycles"] == 0
+    # non-fallback counters are not copied
+    assert "burst_dispatches" not in fc["treatment"]
+    assert fc["control"]["host_cycles"] == 30

@@ -49,6 +49,23 @@ def test_traffic_soak_quick(tmp_path):
     assert _validate(out) == []
 
 
+def test_northstar_hetero_quick(tmp_path):
+    """The heterogeneous fast path end to end at smoke scale: in-kernel
+    fungibility burst arm + 2-shard arm + host oracle, interleaved, with
+    a schema-valid 'hetero' block."""
+    out = str(tmp_path / "NORTHSTAR_r99.json")
+    d = _run_quick("northstar_e2e.py", out, extra=(
+        "--burst", "--ab-hetero", "--flavors", "4", "--resources", "3",
+        "--ab-shards", "2", "--burst-backend", "cpu"))
+    assert d["quick"] is True
+    h = d["hetero"]
+    assert h["decisions_identical_across_arms"] is True
+    assert h["zero_host_fallbacks"] is True
+    assert h["fallbacks"]["burst_dirty_scalar"] == 0
+    assert h["drift"]["environment_drift"]["interleaved"] is True
+    assert _validate(out) == []
+
+
 def test_chaos_soak_quick(tmp_path):
     out = str(tmp_path / "CHAOS_r99.json")
     d = _run_quick("chaos_soak.py", out)

@@ -272,7 +272,11 @@ def test_flavor_fungibility_policies(borrow_policy, preempt_policy):
                                      [1000, 2000, 3000])})]))
         return out
 
-    assert_parity(build)
+    # non-default fungibility combos run the in-kernel walk now — the
+    # wall moved: decisions must still match the host, with NO scalar
+    # fallback heads
+    _, stats = assert_parity(build, expect_scalar=False)
+    assert stats["scalar_heads"] == 0, stats
 
 
 # ---------------------------------------------------------------------------
