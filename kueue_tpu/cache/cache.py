@@ -96,6 +96,12 @@ class Cache:
         # consumer-mutated trees are re-cloned.  KUEUE_TPU_SNAP_INCREMENTAL=0
         # restores the old full-rebuild-every-cycle behavior (used by
         # the parity tests).
+        # Bulk-apply support: while deferred, topology mutations mark
+        # the hierarchy pending instead of re-deriving the quota trees,
+        # so applying N ClusterQueues costs one O(N) rebuild, not N
+        # (the O(N^2) setup wall at 100k CQs).
+        self._rebuild_deferred = False
+        self._rebuild_pending = False
         self._snap_cache: Optional[_SnapCache] = None
         self._snap_incremental = os.environ.get(
             "KUEUE_TPU_SNAP_INCREMENTAL", "1").lower() not in ("0", "false")
@@ -462,9 +468,36 @@ class Cache:
     # Internal wiring
     # ------------------------------------------------------------------
 
+    def deferred_rebuild(self):
+        """Context manager batching topology mutations: ``_rebuild`` is
+        suppressed inside the block and runs exactly once on exit (if
+        any mutation asked for it).  Reads inside the block see stale
+        quota trees / activeness — callers must not schedule against
+        the cache until the block closes."""
+        from contextlib import contextmanager
+
+        @contextmanager
+        def _ctx():
+            with self._lock:
+                already = self._rebuild_deferred
+                self._rebuild_deferred = True
+            try:
+                yield self
+            finally:
+                with self._lock:
+                    if not already:
+                        self._rebuild_deferred = False
+                        if self._rebuild_pending:
+                            self._rebuild_pending = False
+                            self._rebuild()
+        return _ctx()
+
     def _rebuild(self) -> None:
         """Mirror hierarchy edges into the state payloads and recompute the
         subtree quotas from every root (reference resource_node.go:157)."""
+        if self._rebuild_deferred:
+            self._rebuild_pending = True
+            return
         for node in self._mgr.cohorts.values():
             payload = node.payload
             payload.parent = node.parent.payload if node.parent else None

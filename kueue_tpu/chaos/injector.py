@@ -24,6 +24,10 @@ site                            effect at the call point
                                 before the status mutations
 ``wal.finish``                  crash after the finish op is journaled but
                                 before the conditions flip
+``wal.compact``                 crash mid-compaction: the checkpoint temp
+                                file is written and fsynced but the atomic
+                                rename has not happened (recovery reads
+                                the old, uncompacted journal)
 ``shard.device_loss``           drop ``payload`` devices from the burst mesh
                                 (re-partition over the survivors)
 ``journal.drop_touch``          eat a PackJournal ``touch`` (lost update;

@@ -128,6 +128,7 @@ class Driver:
         self._burst_m = 0           # sticky M bucket across burst packs
         self._burst_pack_state = None  # persistent delta-pack records
         self._wal = None            # write-ahead cycle journal (CycleWAL)
+        self._bulk_applied_cqs = None  # non-None inside bulk_apply()
         # CQs whose interrupted-cycle decision was recovered from the
         # WAL tail: they sit out the first post-recovery cycle so the
         # completed cycle matches the uncrashed one decision-for-decision
@@ -218,12 +219,44 @@ class Driver:
             webhooks.validate_cluster_queue(spec)
         self.cache.add_or_update_cluster_queue(spec)
         self.queues.add_cluster_queue(spec)
-        self._sync_cq_activeness()
-        self.queues.queue_inadmissible_workloads([spec.name])
-        self.metrics.cluster_queue_status(spec.name,
-                                          self.cache.cluster_queue(spec.name).active)
+        if self._bulk_applied_cqs is not None:
+            # inside bulk_apply(): activeness sync, inadmissible requeue
+            # and status metrics run once over all applied CQs on exit
+            self._bulk_applied_cqs.append(spec.name)
+        else:
+            self._sync_cq_activeness()
+            self.queues.queue_inadmissible_workloads([spec.name])
+            self.metrics.cluster_queue_status(
+                spec.name, self.cache.cluster_queue(spec.name).active)
         if spec.stop_policy == StopPolicy.HOLD_AND_DRAIN:
             self._drain_cluster_queue(spec.name)
+
+    def bulk_apply(self):
+        """Context manager for large topology pushes (the CRD re-list on
+        startup, scale tests): defers the cache's quota-tree rebuild and
+        the per-apply activeness/metrics sync so N ``apply_*`` calls
+        cost one O(N) settle on exit instead of N — without it, setup
+        is O(N^2) and walls out near 100k CQs.  Scheduling inside the
+        block sees stale quota trees; apply everything, then exit."""
+        from contextlib import contextmanager
+
+        @contextmanager
+        def _ctx():
+            outer = self._bulk_applied_cqs is not None
+            if not outer:
+                self._bulk_applied_cqs = []
+            with self.cache.deferred_rebuild():
+                yield self
+            if not outer:
+                names, self._bulk_applied_cqs = \
+                    self._bulk_applied_cqs, None
+                self._sync_cq_activeness()
+                self.queues.queue_inadmissible_workloads(names)
+                for name in names:
+                    cq = self.cache.cluster_queue(name)
+                    if cq is not None:
+                        self.metrics.cluster_queue_status(name, cq.active)
+        return _ctx()
 
     def _drain_cluster_queue(self, cq_name: str) -> None:
         """HoldAndDrain evicts admitted workloads (reference
@@ -454,12 +487,17 @@ class Driver:
         st.message = message
         st.last_transition_time = now
         # check states gate pack rows but mutate in place (no queue or
-        # cache write on the pending path) — mark the routed CQ dirty
+        # cache write on the pending path) — row-grade dirt: exactly
+        # this workload's ok bit can move, the CQ's membership and
+        # aggregates cannot.  Structural follow-ons below (admitted
+        # sync, eviction) journal their own hard touches, which
+        # supersede the row entry at drain time.
         lq = self.queues.local_queues.get(f"{wl.namespace}/{wl.queue_name}")
         if lq is not None:
-            self.queues.pack_journal.touch(lq.cluster_queue)
+            self.queues.pack_journal.touch_row(lq.cluster_queue, key)
         elif wl.admission is not None:
-            self.queues.pack_journal.touch(wl.admission.cluster_queue)
+            self.queues.pack_journal.touch_row(
+                wl.admission.cluster_queue, key)
         else:
             self.queues.pack_journal.touch_all()
         if state == AdmissionCheckState.READY:
@@ -1279,6 +1317,21 @@ class Driver:
         }
         if self._burst_solver is not None:
             out["burst"] = dict(self._burst_solver.stats)
+            # streaming-pack host-cost block: the kueue_pack_* series
+            # (arena occupancy/growth, row/rank patches, dtype-tighten
+            # savings) split out of the flat solver counters
+            bs = out["burst"]
+            out["pack"] = {k: bs[k] for k in (
+                "stream_packs", "stream_full_packs", "stream_pack_bails",
+                "stream_pack_s", "pack_last_ms", "pack_row_patches",
+                "pack_rows_verified",
+                "pack_rank_patches", "pack_arena_growth_events",
+                "pack_arena_planes", "pack_arena_bytes",
+                "pack_arena_used_bytes", "pack_tighten_bytes_saved",
+                "pack_tighten_widened", "burst_launch_bytes_h2d")
+                if k in bs}
+        if self._wal is not None and hasattr(self._wal, "stats"):
+            out["wal"] = dict(self._wal.stats)
         solver = self.scheduler.solver
         if solver is not None and hasattr(solver, "stats"):
             ss = solver.stats
@@ -1292,6 +1345,7 @@ class Driver:
             }
         self.metrics.burst_solver_sample(out.get("burst"),
                                          out.get("flavor_walk"))
+        self.metrics.pack_sample(out.get("pack"), out.get("wal"))
         return out
 
     def admitted_keys(self) -> set[str]:

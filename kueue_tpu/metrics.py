@@ -225,6 +225,42 @@ class Registry:
                 self.set_gauge("kueue_burst_scalar_heads_by_reason",
                                (reason,), float(n))
 
+    # -- streaming-pack + WAL series (ops/stream_pack.py arena patching,
+    #    packing.py dtype tightening, utils/journal.py group commit;
+    #    sampled by Driver.stats so the scale harness and /metrics
+    #    agree) --
+
+    def pack_sample(self, pack_stats=None, wal_stats=None) -> None:
+        """Publish the streaming pack's host-cost and arena telemetry as
+        ``kueue_pack_*`` gauges and the WAL's group-commit counters as
+        ``kueue_wal_*`` gauges."""
+        gauge_of = {
+            "stream_packs": "kueue_pack_stream_packs",
+            "stream_full_packs": "kueue_pack_full_packs",
+            "stream_pack_bails": "kueue_pack_stream_bails",
+            "stream_pack_s": "kueue_pack_host_seconds",
+            "pack_last_ms": "kueue_pack_last_ms",
+            "pack_row_patches": "kueue_pack_row_patches",
+            "pack_rows_verified": "kueue_pack_rows_verified",
+            "pack_rank_patches": "kueue_pack_rank_patches",
+            "pack_arena_growth_events": "kueue_pack_arena_growth_events",
+            "pack_arena_planes": "kueue_pack_arena_planes",
+            "pack_arena_bytes": "kueue_pack_arena_bytes",
+            "pack_arena_used_bytes": "kueue_pack_arena_used_bytes",
+            "pack_tighten_bytes_saved": "kueue_pack_tighten_bytes_saved",
+            "pack_tighten_widened": "kueue_pack_tighten_widened",
+            "burst_launch_bytes_h2d": "kueue_pack_bytes_to_device",
+        }
+        if pack_stats:
+            for k, gauge in gauge_of.items():
+                if k in pack_stats:
+                    self.set_gauge(gauge, (), float(pack_stats[k]))
+        if wal_stats:
+            for k in ("wal_appends", "wal_commits", "wal_flushes",
+                      "wal_fsyncs", "wal_compactions"):
+                if k in wal_stats:
+                    self.set_gauge("kueue_" + k, (), float(wal_stats[k]))
+
     def report_weighted_share(self, cq: str, share: float) -> None:
         self.set_gauge("kueue_cluster_queue_weighted_share", (cq,), share)
 

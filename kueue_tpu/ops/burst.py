@@ -180,6 +180,22 @@ def _burst_cycles(
     of quota-holding candidates (candidates sort admitted-first), so
     their cost tracks the candidates actually walked — not the KC = L*M
     table capacity — with no extra compilation shapes."""
+    # dtype-tightened planes (ops/packing.py tighten_arrays) cross the
+    # host boundary narrow and upcast here; already-int32 inputs make
+    # these no-ops that XLA elides.  The kernel body below is unchanged.
+    wl_req = wl_req.astype(jnp.int32)
+    wl_cycle_rank = wl_cycle_rank.astype(jnp.int32)
+    wl_prio = wl_prio.astype(jnp.int32)
+    wl_uidrank = wl_uidrank.astype(jnp.int32)
+    parent = parent.astype(jnp.int32)
+    node_level = node_level.astype(jnp.int32)
+    nominal_cq = nominal_cq.astype(jnp.int32)
+    slot_fr = slot_fr.astype(jnp.int32)
+    forest_of_cq = forest_of_cq.astype(jnp.int32)
+    members = members.astype(jnp.int32)
+    cand_rows = cand_rows.astype(jnp.int32)
+    cand_lmem = cand_lmem.astype(jnp.int32)
+    self_lmem = self_lmem.astype(jnp.int32)
     C, M, R = wl_req.shape
     N, F = subtree.shape
     CM = C * M
@@ -1688,6 +1704,36 @@ def pack_burst_cached(structure, queues, cache, scheduler, clock,
                       stats=None):
     """Delta-maintained pack_burst; returns ``(plan, state, was_delta)``.
 
+    Routing front door: by default the *streaming* delta pack
+    (ops/stream_pack.py) serves the boundary — it patches a persistent
+    packed-universe arena in place, O(arrivals + dirty) per window
+    instead of the classic path's O(total rows) stage-B reassembly.
+    ``KUEUE_TPU_STREAM_PACK=0`` opts back into the classic delta pack,
+    ``KUEUE_BURST_DELTA_PACK=0`` forces a full walk every window
+    (either path), and a structure the streaming encoder cannot model
+    (non-ASCII or oversized workload keys) self-poisons back to the
+    classic path.  Both paths share the return contract and produce
+    bit-identical plans (test-enforced)."""
+    import os
+    if (os.environ.get("KUEUE_TPU_STREAM_PACK", "1") != "0"
+            and os.environ.get("KUEUE_BURST_DELTA_PACK", "1") != "0"
+            and not getattr(structure, "_stream_poison", False)):
+        from .stream_pack import pack_burst_streaming
+        return pack_burst_streaming(structure, queues, cache, scheduler,
+                                    clock, state=state, min_m=min_m,
+                                    window=window, stats=stats)
+    return _pack_burst_cached_classic(structure, queues, cache,
+                                      scheduler, clock, state=state,
+                                      min_m=min_m, window=window,
+                                      stats=stats)
+
+
+def _pack_burst_cached_classic(structure, queues, cache, scheduler,
+                               clock, state=None, min_m: int = 0,
+                               window: int = 0, stats=None):
+    """The classic delta pack: re-walk journaled-dirty CQs, re-fuse
+    stage B from the mixed records.
+
     Drains the queue-manager and cache PackJournals; when ``state``
     covers the same (structure generation, resource scale, CQ set,
     window) key and nothing forced a full walk, only journaled-dirty
@@ -1948,6 +1994,10 @@ class BurstSolver:
         self._resident = None
         self._scatter_jit = None
         self._forest_cost: dict | None = None
+        # dtype tightening of the serial launch's packed planes (sticky
+        # per-plane widths; KUEUE_TPU_PACK_TIGHTEN=0 disables)
+        from .packing import TightenState
+        self._tighten = TightenState()
 
     def set_shards(self, n: int):
         """Shard burst dispatches across ``n`` devices: cohort forests
@@ -2113,8 +2163,22 @@ class BurstSolver:
         st = plan.structure
         dev = self._device()
         a = plan.arrays
+        import os as _os
+        if _os.environ.get("KUEUE_TPU_PACK_TIGHTEN", "1") != "0":
+            # narrow the rank/index/request planes at the serial
+            # transfer boundary only — plan.arrays keeps the reference
+            # int32 dtypes (parity tests, resident scatter); the kernel
+            # upcasts on device.  Scan-state planes are never narrowed
+            # (a chained window feeds device outputs straight back in).
+            from .packing import tighten_arrays
+            a = tighten_arrays(a, self._tighten, self.stats)
         (elig0, parked0, resume0, adm0, adm_seq0, adm_usage0,
          adm_uses0, death0, u_cq0) = state
+        self.stats["burst_launch_bytes_h2d"] = (
+            self.stats.get("burst_launch_bytes_h2d", 0)
+            + sum(v.nbytes for v in a.values()
+                  if isinstance(v, np.ndarray))
+            + sum(v.nbytes for v in state if isinstance(v, np.ndarray)))
         t0 = _time.perf_counter()
         with jax.default_device(dev):
             out = burst_cycles(

@@ -525,3 +525,76 @@ def pack_cycle(snapshot: Snapshot, heads: list[Info], ordering=None,
         wl_priority=wl_priority, wl_timestamp=wl_timestamp, wl_keys=wl_keys,
         exact=exact,
     )
+
+
+# ---------------------------------------------------------------------------
+# Dtype tightening of packed planes (host→device transfer compression)
+# ---------------------------------------------------------------------------
+
+# Planes the serial burst launch may narrow below int32 when their value
+# range permits.  Only *rank/index/request* planes qualify: sentinel
+# planes (wl_rank's INF_I32, death0's I32_MAX) and the chained scan-state
+# 9-tuple are excluded — a chained window receives the previous window's
+# device outputs, so alternating their dtypes would recompile every
+# boundary.  Quota planes holding _LIMIT-scaled sums stay int32 too.
+TIGHTEN_PLANES = ("wl_req", "wl_cycle_rank", "wl_prio", "wl_uidrank",
+                  "parent", "node_level", "nominal_cq", "slot_fr",
+                  "forest_of_cq", "members", "cand_rows", "cand_lmem",
+                  "self_lmem")
+
+_WIDTH_DT = {1: np.int8, 2: np.int16, 4: np.int32}
+
+
+class TightenState:
+    """Sticky per-plane narrow widths.  Widths only ever widen: a plane
+    that once overflowed int16 stays int32 for the solver's lifetime,
+    so the jit cache sees at most a couple of dtype signatures per
+    plane instead of oscillating (every signature is a compilation)."""
+    __slots__ = ("width", "widen_events")
+
+    def __init__(self):
+        self.width: dict[str, int] = {}
+        self.widen_events = 0
+
+
+def _needed_width(arr: np.ndarray) -> int:
+    if arr.size == 0:
+        return 1
+    lo, hi = int(arr.min()), int(arr.max())
+    if -128 <= lo and hi <= 127:
+        return 1
+    if -32768 <= lo and hi <= 32767:
+        return 2
+    return 4
+
+
+def tighten_arrays(arrays: dict, state: TightenState,
+                   stats: dict = None) -> dict:
+    """Return a shallow copy of ``arrays`` with the TIGHTEN_PLANES
+    narrowed to the smallest sticky width their values fit (range
+    measured per call — the assert is the measurement; overflow never
+    truncates, it widens).  The input dict is never mutated: plan
+    arrays keep their reference int32 dtypes for parity checks and the
+    resident scatter path."""
+    out = dict(arrays)
+    saved = 0
+    for name in TIGHTEN_PLANES:
+        a = out.get(name)
+        if a is None or a.dtype != np.int32:
+            continue
+        need = _needed_width(a)
+        prev = state.width.get(name)
+        if prev is not None and need > prev:
+            state.widen_events += 1
+            if stats is not None:
+                stats["pack_tighten_widened"] = (
+                    stats.get("pack_tighten_widened", 0) + 1)
+        width = max(need, prev or 1)
+        state.width[name] = width
+        if width < 4:
+            out[name] = a.astype(_WIDTH_DT[width])
+            saved += a.nbytes - out[name].nbytes
+    if stats is not None and saved:
+        stats["pack_tighten_bytes_saved"] = (
+            stats.get("pack_tighten_bytes_saved", 0) + saved)
+    return out

@@ -350,12 +350,18 @@ _FALLBACK_KEYS = ("host_cycles", "scalar_heads", "resume_heads",
                   "walk_stop_heads", "native_ff_fallbacks",
                   "burst_dirty_cycles", "burst_dirty_preempt",
                   "burst_dirty_scalar", "burst_dirty_resume",
-                  "burst_suppressed_cycles")
+                  "burst_suppressed_cycles",
+                  # streaming-pack visibility: an arm claiming
+                  # O(arrivals + dirty) host cost must show how many
+                  # windows actually streamed vs fell back to full walks
+                  "stream_packs", "stream_full_packs",
+                  "stream_pack_bails", "pack_row_patches",
+                  "pack_rank_patches", "pack_tighten_bytes_saved")
 
 
 def _fallback_counters(arm: dict) -> dict:
     out: dict = {}
-    for src_key in ("solver_stats", "flavor_walk", "burst_stats"):
+    for src_key in ("solver_stats", "flavor_walk", "burst_stats", "pack"):
         src = arm.get(src_key)
         if isinstance(src, dict):
             for k in _FALLBACK_KEYS:

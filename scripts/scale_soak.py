@@ -1,0 +1,525 @@
+"""Scale soak: the streaming delta-pack scaling law, 1k -> 100k CQs.
+
+Publishes ``SCALE_r13.json``:
+
+  curve   — per-universe-size (CQs 1k..100k) host pack cost for the
+            streaming arena vs a from-scratch rebuild measured on the
+            SAME live state at the SAME boundary (the rebuild therefore
+            doubles as the interleaved same-box control), plane-parity
+            verdicts (bytes-identical packed planes), bytes-to-device
+            before/after dtype tightening, end-to-end burst cycle wall
+            cost and decision A/B between the streaming and
+            rebuild-every-boundary drivers, and RSS;
+  soak    — a 10M-workload streaming run at the largest size with a
+            group-committed, auto-compacting CycleWAL attached:
+            workloads arrive, admit through the fused device path,
+            finish, and are deleted in rounds until the target count
+            has flowed through one box;
+  parity  — every probed size must report bytes-identical planes AND
+            bit-identical decisions between arms.
+
+The claim under test (ISSUE 11): host pack cost is O(arrivals + dirty
+rows), not O(universe) — the streaming arm's pack ms stays flat as CQs
+grow 100x while the rebuild arm grows linearly, >= 5x apart at 100k.
+
+Usage:
+    python scripts/scale_soak.py [--sizes 1000,4000,...] [--seed N]
+        [--boundaries N] [--rounds N] [--soak-workloads N]
+        [--quick] [--out SCALE_r13.json]
+"""
+
+from __future__ import annotations
+
+import argparse
+import gc
+import json
+import os
+import random
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import numpy as np
+
+from kueue_tpu.api.types import (
+    ClusterQueue,
+    FlavorQuotas,
+    LocalQueue,
+    PreemptionPolicy,
+    QueueingStrategy,
+    ResourceFlavor,
+    ResourceGroup,
+    ResourceQuota,
+    Workload,
+    PodSet,
+)
+from kueue_tpu.controller.driver import Driver
+from kueue_tpu.ops.burst import pack_burst, pack_burst_cached
+from kueue_tpu.ops.packing import TightenState, tighten_arrays
+from kueue_tpu.perf.harness import ab_block
+from kueue_tpu.utils.journal import CycleWAL
+
+
+class VirtualClock:
+    def __init__(self, t=1000.0):
+        self.t = t
+
+    def __call__(self):
+        return self.t
+
+
+def log(msg: str) -> None:
+    print(msg, file=sys.stderr, flush=True)
+
+
+def mesh_info() -> dict:
+    import jax
+    devs = jax.devices()
+    return {"n_devices": len(devs),
+            "platform": devs[0].platform if devs else "none"}
+
+
+def rss_mb() -> float:
+    """Current resident set from /proc (no psutil dependency)."""
+    try:
+        with open("/proc/self/status") as fh:
+            for line in fh:
+                if line.startswith("VmRSS:"):
+                    return round(int(line.split()[1]) / 1024.0, 1)
+    except OSError:
+        pass
+    return -1.0
+
+
+def build(n_cqs: int) -> tuple[Driver, VirtualClock]:
+    """Cohorts of 4, 4000m cpu nominal, BEST_EFFORT_FIFO — the
+    chaos/traffic soak cluster shape scaled out."""
+    clock = VirtualClock()
+    d = Driver(clock=clock, use_device_solver=True)
+    d.apply_resource_flavor(ResourceFlavor(name="default"))
+    with d.bulk_apply():   # one O(N) settle instead of N rebuilds
+        for q in range(n_cqs):
+            name = f"cq-{q}"
+            d.apply_cluster_queue(ClusterQueue(
+                name=name, cohort=f"co-{q // 4}",
+                queueing_strategy=QueueingStrategy.BEST_EFFORT_FIFO,
+                preemption=PreemptionPolicy(),
+                resource_groups=[ResourceGroup(
+                    covered_resources=["cpu"],
+                    flavors=[FlavorQuotas(name="default", resources={
+                        "cpu": ResourceQuota(nominal=4000)})])]))
+            d.apply_local_queue(LocalQueue(name=f"lq-{q}",
+                                           cluster_queue=name))
+    return d, clock
+
+
+def mk(name: str, lq: str, cpu: int, prio: int, t: float) -> Workload:
+    return Workload(name=name, queue_name=lq, priority=prio,
+                    creation_time=t,
+                    pod_sets=[PodSet(name="main", count=1,
+                                     requests={"cpu": cpu})])
+
+
+def preload(d, clock, n_cqs: int, seed: int) -> None:
+    """Two 2500m workloads per CQ (one fits the 4000m nominal, one
+    queues behind it), then one fused cycle to admit the first wave —
+    every CQ ends with one admitted + one pending row."""
+    rng = random.Random(seed)
+    for q in range(n_cqs):
+        for j in range(2):
+            d.create_workload(mk(f"pre-{q}-{j}", f"lq-{q}", 2500,
+                                 prio=rng.choice([0, 10, 20]),
+                                 t=float(q * 2 + j)))
+    clock.t += 1.0
+    d.schedule_burst(1)
+
+
+def current_structure(d):
+    solver = d.scheduler.solver
+    st = solver._structure
+    if st is None or st.generation != d.cache.structure_generation:
+        st = solver._structure_for(d.cache.snapshot(), [])
+    return st
+
+
+def plans_equal(a, b) -> bool:
+    if a is None or b is None:
+        return a is None and b is None
+    for attr in ("C", "M", "L", "G", "n_levels", "KC", "seq_base",
+                 "max_res_ts"):
+        if getattr(a, attr) != getattr(b, attr):
+            return False
+    if set(a.arrays) != set(b.arrays):
+        return False
+    for name in a.arrays:
+        x, y = np.asarray(a.arrays[name]), np.asarray(b.arrays[name])
+        if x.dtype != y.dtype or x.shape != y.shape \
+                or not np.array_equal(x, y):
+            return False
+    return a.keys == b.keys and a.row_of_key == b.row_of_key
+
+
+def churn(d, clock, rng, n_cqs: int, n_churn: int, tag: str) -> None:
+    """O(activity) mutation batch: ``n_churn`` CQs get one arrival,
+    half of them also finish their admitted head (which is then
+    deleted, the 10M-soak's row-retirement path)."""
+    cqs = rng.sample(range(n_cqs), min(n_churn, n_cqs))
+    clock.t += 1.0
+    for i, q in enumerate(cqs):
+        d.create_workload(mk(f"{tag}-{q}", f"lq-{q}", 2500,
+                             prio=rng.choice([0, 10, 20]),
+                             t=clock.t + i * 1e-3))
+        if i % 2 == 0:
+            key = f"default/pre-{q}-0"
+            wl = d.workloads.get(key)
+            if wl is not None and wl.has_quota_reservation \
+                    and not wl.is_finished:
+                d.finish_workload(key)
+                d.delete_workload(key)
+
+
+# ---------------------------------------------------------------------------
+# Phase A: pack scaling law (streaming vs rebuild on the same state)
+# ---------------------------------------------------------------------------
+
+def pack_curve_point(n_cqs: int, boundaries: int, n_churn: int,
+                     seed: int) -> dict:
+    log(f"[pack] cqs={n_cqs}: building cluster ...")
+    t0 = time.perf_counter()
+    d, clock = build(n_cqs)
+    preload(d, clock, n_cqs, seed)
+    log(f"[pack] cqs={n_cqs}: preloaded in "
+        f"{time.perf_counter() - t0:.1f}s, rss={rss_mb()}MB")
+
+    rng = random.Random(seed + 1)
+    stats: dict = {}
+    state = None
+    tight = TightenState()
+    stream_ms, rebuild_ms = [], []
+    planes_identical = True
+    bytes_raw = bytes_tight = rows = 0
+    for b in range(boundaries):
+        churn(d, clock, rng, n_cqs, n_churn, f"ch{b}")
+        st = current_structure(d)
+        t1 = time.perf_counter()
+        plan_s, state, _ = pack_burst_cached(
+            st, d.queues, d.cache, d.scheduler, clock,
+            state=state, stats=stats)
+        t2 = time.perf_counter()
+        plan_f = pack_burst(st, d.queues, d.cache, d.scheduler, clock)
+        t3 = time.perf_counter()
+        if b > 0:   # boundary 0 is the counted cold full pack
+            stream_ms.append((t2 - t1) * 1e3)
+            rebuild_ms.append((t3 - t2) * 1e3)
+        if not plans_equal(plan_s, plan_f):
+            planes_identical = False
+            log(f"[pack] cqs={n_cqs} boundary {b}: PLANES DIVERGED")
+        if plan_s is not None:
+            arrays = plan_s.arrays
+            bytes_raw = sum(int(np.asarray(v).nbytes)
+                            for v in arrays.values())
+            bytes_tight = sum(
+                int(np.asarray(v).nbytes)
+                for v in tighten_arrays(arrays, tight).values())
+            rows = sum(1 for row in plan_s.keys
+                       for k in row if k is not None)
+    out = {
+        "cqs": n_cqs,
+        "rows": rows,
+        "boundaries": boundaries,
+        "churn_cqs_per_boundary": n_churn,
+        "pack_ms_stream": round(float(np.median(stream_ms)), 3),
+        "pack_ms_rebuild": round(float(np.median(rebuild_ms)), 3),
+        "pack_speedup": round(float(np.median(rebuild_ms))
+                              / max(float(np.median(stream_ms)), 1e-9),
+                              2),
+        "planes_identical": planes_identical,
+        "bytes_to_device_raw": bytes_raw,
+        "bytes_to_device": bytes_tight,
+        "tighten_ratio": round(bytes_raw / max(bytes_tight, 1), 2),
+        "stream_packs": stats.get("stream_packs", 0),
+        "stream_full_packs": stats.get("stream_full_packs", 0),
+        "pack_rank_patches": stats.get("pack_rank_patches", 0),
+        "arena_bytes": stats.get("pack_arena_bytes", 0),
+        "rss_mb": rss_mb(),
+    }
+    log(f"[pack] cqs={n_cqs}: stream={out['pack_ms_stream']}ms "
+        f"rebuild={out['pack_ms_rebuild']}ms "
+        f"speedup={out['pack_speedup']}x "
+        f"parity={'OK' if planes_identical else 'DIVERGED'}")
+    del d
+    gc.collect()
+    return out
+
+
+# ---------------------------------------------------------------------------
+# Phase B: end-to-end decision A/B (streaming vs rebuild drivers)
+# ---------------------------------------------------------------------------
+
+_ARM_ENV = {
+    "stream": {"KUEUE_TPU_STREAM_PACK": "1"},
+    "rebuild": {"KUEUE_TPU_STREAM_PACK": "0",
+                "KUEUE_BURST_DELTA_PACK": "0"},
+}
+
+
+def e2e_arm(arm: str, n_cqs: int, rounds: int, n_churn: int,
+            seed: int) -> dict:
+    old = {k: os.environ.get(k) for k in
+           ("KUEUE_TPU_STREAM_PACK", "KUEUE_BURST_DELTA_PACK")}
+    os.environ.update(_ARM_ENV[arm])
+    try:
+        d, clock = build(n_cqs)
+        preload(d, clock, n_cqs, seed)
+        rng = random.Random(seed + 2)
+        decisions = []
+        n_cycles = 0
+        wall = 0.0
+        # round 0 is an untimed warmup: it absorbs the fused kernel's
+        # JIT compiles (shape-dependent, cached process-wide) so the
+        # timed rounds measure steady state — its DECISIONS still count
+        # toward the parity check
+        for r in range(rounds + 1):
+            churn(d, clock, rng, n_cqs, n_churn, f"e2e{r}")
+            t0 = time.perf_counter()
+            recs = d.schedule_burst(
+                3, runtime=2,
+                on_cycle_start=lambda k: setattr(clock, "t",
+                                                 clock.t + 1.0))
+            if r > 0:
+                wall += time.perf_counter() - t0
+                n_cycles += len(recs)
+            decisions.extend(
+                (sorted(s.admitted), sorted(s.skipped),
+                 sorted(s.preempted_targets)) for s in recs)
+        bs = dict(d._burst_solver.stats) if d._burst_solver else {}
+        pack_block = d.stats.get("pack", {})
+    finally:
+        for k, v in old.items():
+            if v is None:
+                os.environ.pop(k, None)
+            else:
+                os.environ[k] = v
+    del d
+    gc.collect()
+    return {"arm": arm, "decisions": decisions,
+            "cycle_wall_ms": round(wall * 1e3 / max(n_cycles, 1), 2),
+            "n_cycles": n_cycles,
+            "bytes_h2d": int(bs.get("burst_launch_bytes_h2d", 0)),
+            "pack": pack_block}
+
+
+# ---------------------------------------------------------------------------
+# Phase C: the 10M-workload soak
+# ---------------------------------------------------------------------------
+
+def soak(n_cqs: int, target: int, seed: int, wal_path: str,
+         commit_every: int) -> dict:
+    log(f"[soak] cqs={n_cqs} target={target} workloads, "
+        f"wal commit_every={commit_every} ...")
+    t0 = time.perf_counter()
+    d, clock = build(n_cqs)
+    wal = CycleWAL(wal_path, commit_every=commit_every,
+                   compact_every=64)
+    d.attach_wal(wal)
+    rng = random.Random(seed + 3)
+    created = finished = admitted = 0
+    rounds = 0
+    prios = [0, 10, 20]
+    peak_rss = rss_mb()
+    t_report = t0
+    while created < target:
+        batch = min(n_cqs, target - created)
+        clock.t += 1.0
+        for i in range(batch):
+            q = i % n_cqs
+            d.create_workload(mk(f"s{rounds}-{i}", f"lq-{q}", 2500,
+                                 prio=prios[(rounds + i) % 3],
+                                 t=clock.t + i * 1e-4))
+        created += batch
+        recs = d.schedule_burst(
+            4, runtime=2,
+            on_cycle_start=lambda k: setattr(clock, "t",
+                                             clock.t + 1.0))
+        for s in recs:
+            admitted += len(s.admitted)
+        # retire finished rows so the live store stays O(active)
+        done = [k for k, w in d.workloads.items() if w.is_finished]
+        for k in done:
+            d.delete_workload(k)
+        finished += len(done)
+        rounds += 1
+        peak_rss = max(peak_rss, rss_mb())
+        now = time.perf_counter()
+        if now - t_report > 30.0:
+            t_report = now
+            log(f"[soak] {created}/{target} created, "
+                f"{admitted} admitted, {finished} retired, "
+                f"round {rounds}, rss={rss_mb()}MB, "
+                f"{now - t0:.0f}s")
+    # drain the in-flight tail
+    for _ in range(4):
+        recs = d.schedule_burst(
+            4, runtime=2,
+            on_cycle_start=lambda k: setattr(clock, "t",
+                                             clock.t + 1.0))
+        for s in recs:
+            admitted += len(s.admitted)
+        done = [k for k, w in d.workloads.items() if w.is_finished]
+        for k in done:
+            d.delete_workload(k)
+        finished += len(done)
+    wal_stats = dict(wal.stats)
+    wal.close()
+    wal_size = os.path.getsize(wal_path) if os.path.exists(wal_path) \
+        else 0
+    pack_block = d.stats.get("pack", {})
+    wall = time.perf_counter() - t0
+    out = {
+        "cqs": n_cqs,
+        "target_workloads": target,
+        "created": created,
+        "admitted": admitted,
+        "finished": finished,
+        "rounds": rounds,
+        "completed": created >= target,
+        "wall_s": round(wall, 1),
+        "workloads_per_s": round(created / max(wall, 1e-9), 1),
+        "peak_rss_mb": peak_rss,
+        "wal": {**wal_stats,
+                "commit_every": commit_every,
+                "compact_every": 64,
+                "final_file_bytes": wal_size},
+        "pack_counters": pack_block,
+    }
+    log(f"[soak] done: {created} workloads in {out['wall_s']}s "
+        f"({out['workloads_per_s']}/s), {admitted} admitted, "
+        f"wal compactions={wal_stats.get('wal_compactions', 0)} "
+        f"file={wal_size}B")
+    del d
+    gc.collect()
+    return out
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser(description=__doc__)
+    ap.add_argument("--sizes", default="",
+                    help="comma-separated CQ universe sizes")
+    ap.add_argument("--seed", type=int,
+                    default=int(os.environ.get("KUEUE_TPU_SCALE_SEED",
+                                               "1307")))
+    ap.add_argument("--boundaries", type=int, default=8,
+                    help="measured pack boundaries per size")
+    ap.add_argument("--rounds", type=int, default=3,
+                    help="churn+burst rounds per end-to-end arm")
+    ap.add_argument("--churn", type=int, default=64,
+                    help="CQs churned per boundary (the 'activity')")
+    ap.add_argument("--soak-workloads", type=int, default=0,
+                    help="0 = 10M full / 100k quick")
+    ap.add_argument("--quick", action="store_true",
+                    help="4k-CQ ceiling + 100k-workload soak")
+    ap.add_argument("--out", default=os.path.join(
+        os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+        "SCALE_r13.json"))
+    args = ap.parse_args()
+
+    if args.sizes:
+        sizes = [int(s) for s in args.sizes.split(",") if s]
+    elif args.quick:
+        sizes = [1000, 4000]
+    else:
+        sizes = [1000, 4000, 10000, 30000, 100000]
+    boundaries = 4 if args.quick else args.boundaries
+    soak_target = args.soak_workloads or (100_000 if args.quick
+                                          else 10_000_000)
+    soak_cqs = sizes[-1]
+    commit_every = int(os.environ.get("KUEUE_TPU_WAL_COMMIT_EVERY",
+                                      "64"))
+    t_start = time.perf_counter()
+    log(f"scale soak: sizes={sizes} boundaries={boundaries} "
+        f"churn={args.churn} soak={soak_target}@{soak_cqs}cqs "
+        f"seed={args.seed}")
+
+    curve = []
+    for n in sizes:
+        point = pack_curve_point(n, boundaries, args.churn, args.seed)
+        # end-to-end A/B, rebuild interleaved right after streaming on
+        # the same box (the environment-drift control)
+        e_s = e2e_arm("stream", n, args.rounds, args.churn, args.seed)
+        e_r = e2e_arm("rebuild", n, args.rounds, args.churn, args.seed)
+        point["decisions_identical"] = \
+            e_s["decisions"] == e_r["decisions"]
+        point["cycle_wall_ms"] = e_s["cycle_wall_ms"]
+        point["cycle_wall_ms_rebuild"] = e_r["cycle_wall_ms"]
+        point["bytes_h2d_e2e"] = e_s["bytes_h2d"]
+        point["e2e_cycles"] = e_s["n_cycles"]
+        point["pack_counters"] = e_s["pack"]
+        point["pack_counters_rebuild"] = e_r["pack"]
+        log(f"[e2e] cqs={n}: cycle={e_s['cycle_wall_ms']}ms "
+            f"(rebuild {e_r['cycle_wall_ms']}ms) decisions "
+            f"{'identical' if point['decisions_identical'] else 'DIVERGED'}")
+        curve.append(point)
+
+    wal_path = os.path.join(os.path.dirname(args.out),
+                            "scale_soak_wal.jsonl")
+    soak_block = soak(soak_cqs, soak_target, args.seed, wal_path,
+                      commit_every)
+    try:
+        os.remove(wal_path)
+    except OSError:
+        pass
+
+    top = curve[-1]
+    parity = {
+        "planes_identical_all": all(p["planes_identical"]
+                                    for p in curve),
+        "decisions_identical_all": all(p["decisions_identical"]
+                                       for p in curve),
+    }
+    drift = ab_block(
+        treatment={"arm": "stream", "cqs": top["cqs"],
+                   "pack_ms": top["pack_ms_stream"],
+                   "cycle_wall_ms": top["cycle_wall_ms"],
+                   "pack": top["pack_counters"]},
+        control={"arm": "rebuild", "interleaved": True,
+                 "cqs": top["cqs"],
+                 "pack_ms": top["pack_ms_rebuild"],
+                 "cycle_wall_ms": top["cycle_wall_ms_rebuild"],
+                 "pack": top["pack_counters_rebuild"]})
+
+    tail = {
+        "metric": "streaming_pack_speedup_at_max_cqs",
+        "unit": "rebuild pack ms / streaming pack ms at the largest "
+                "probed universe",
+        "value": top["pack_speedup"],
+        "cqs": top["cqs"],
+        "seed": args.seed,
+        "quick": bool(args.quick),
+        "mesh": mesh_info(),
+        "sizes": sizes,
+        "curve": curve,
+        "parity": parity,
+        "soak": soak_block,
+        "control": drift["control"],
+        "environment_drift": drift,
+        "wall_s_total": round(time.perf_counter() - t_start, 1),
+    }
+    print(json.dumps({
+        "metric": tail["metric"], "cqs": tail["cqs"],
+        "value": tail["value"],
+        "planes_identical_all": parity["planes_identical_all"],
+        "decisions_identical_all": parity["decisions_identical_all"],
+        "soak_completed": soak_block["completed"]}))
+    with open(args.out, "w") as f:
+        json.dump(tail, f, indent=1)
+        f.write("\n")
+    log(f"wrote {args.out} ({tail['wall_s_total']}s total)")
+    ok = (parity["planes_identical_all"]
+          and parity["decisions_identical_all"]
+          and soak_block["completed"])
+    return 0 if ok else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

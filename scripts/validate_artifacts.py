@@ -234,6 +234,73 @@ def _check_hetero(d, path, out):
                  "interleaved=true and a 'fallback_counters' object")
 
 
+def _check_scale(d, path, out):
+    """SCALE_* scaling-law artifacts (scripts/scale_soak.py): the
+    per-universe-size curve (streaming vs rebuild host pack ms measured
+    on the same state, end-to-end cycle cost, bytes-to-device, RSS,
+    per-size parity verdicts), the all-sizes parity booleans, the
+    completed high-count workload soak, and the interleaved same-box
+    control arm."""
+    curve = d.get("curve")
+    if not isinstance(curve, list) or not curve:
+        _err(out, path, "'curve' must be a non-empty list of sizes")
+        curve = []
+    for e in curve:
+        if not isinstance(e, dict):
+            _err(out, path, "'curve' entries must be objects")
+            continue
+        n = e.get("cqs")
+        if not isinstance(n, int) or n < 1:
+            _err(out, path, "'curve' entry missing int 'cqs' >= 1")
+            continue
+        for k in ("pack_ms_stream", "pack_ms_rebuild",
+                  "cycle_wall_ms", "rss_mb"):
+            if not isinstance(e.get(k), (int, float)):
+                _err(out, path, f"'curve' size {n}: missing numeric "
+                     f"'{k}'")
+        for k in ("bytes_to_device", "bytes_to_device_raw"):
+            if not isinstance(e.get(k), int):
+                _err(out, path, f"'curve' size {n}: missing int '{k}'")
+        for k in ("planes_identical", "decisions_identical"):
+            if not isinstance(e.get(k), bool):
+                _err(out, path, f"'curve' size {n}: missing bool '{k}'")
+    parity = d.get("parity")
+    if not isinstance(parity, dict):
+        _err(out, path, "'parity' must be an object")
+    else:
+        for k in ("planes_identical_all", "decisions_identical_all"):
+            v = parity.get(k)
+            if not isinstance(v, bool):
+                _err(out, path, f"'parity.{k}' must be a bool")
+            elif curve and all(isinstance(e, dict) for e in curve):
+                per = k.rsplit("_", 1)[0]
+                got = all(e.get(per) is True for e in curve)
+                if v != got:
+                    _err(out, path, f"'parity.{k}'={v} inconsistent "
+                         "with the per-size verdicts")
+    soak = d.get("soak")
+    if not isinstance(soak, dict):
+        _err(out, path, "'soak' must be an object")
+    else:
+        for k in ("target_workloads", "created", "admitted", "rounds"):
+            if not isinstance(soak.get(k), int):
+                _err(out, path, f"'soak.{k}' must be an int")
+        done = soak.get("completed")
+        if not isinstance(done, bool):
+            _err(out, path, "'soak.completed' must be a bool")
+        elif isinstance(soak.get("created"), int) \
+                and isinstance(soak.get("target_workloads"), int) \
+                and done != (soak["created"] >= soak["target_workloads"]):
+            _err(out, path, f"'soak.completed'={done} inconsistent with "
+                 f"created={soak['created']} vs "
+                 f"target={soak['target_workloads']}")
+    control = d.get("control")
+    if not isinstance(control, dict) \
+            or control.get("interleaved") is not True:
+        _err(out, path, "'control' must be an object with "
+             "interleaved=true (same-box environment-drift arm)")
+
+
 def _check_traffic(d, path, out):
     """TRAFFIC_* open-loop artifacts (scripts/traffic_soak.py): the
     arrival-process parameters, the SLO, per-arm sustainable-rate
@@ -296,7 +363,7 @@ def _check_traffic(d, path, out):
 # generator scripts that postdate the schema convention (metric+value
 # at top level); older BENCH_/MULTICHIP_r01-05 wrappers predate it and
 # only get the common checks
-_STRICT_PREFIXES = ("NORTHSTAR_", "CHAOS_", "TRAFFIC_")
+_STRICT_PREFIXES = ("NORTHSTAR_", "CHAOS_", "TRAFFIC_", "SCALE_")
 
 
 def validate(path: str) -> list[str]:
@@ -317,6 +384,10 @@ def validate(path: str) -> list[str]:
     # artifact even if the file was renamed
     if base.startswith("TRAFFIC_") or "arms" in d:
         _check_traffic(d, path, out)
+    # by name or by shape: a per-size soak+parity record is a scale
+    # artifact even if the file was renamed
+    if base.startswith("SCALE_") or ("soak" in d and "parity" in d):
+        _check_scale(d, path, out)
     m = re.match(r"MULTICHIP_R(\d+)", base)
     if base.startswith(_STRICT_PREFIXES) or (m and int(m.group(1)) >= 8):
         _check_metric_value(d, path, out)

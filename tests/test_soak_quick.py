@@ -66,6 +66,28 @@ def test_northstar_hetero_quick(tmp_path):
     assert _validate(out) == []
 
 
+def test_scale_soak_quick(tmp_path):
+    """The scale ceiling end to end at smoke scale: streaming vs
+    rebuild pack arms on the same state up to 4k CQs, bit-identical
+    planes + decisions at every probed size, and a completed mini-soak
+    with the group-committed WAL attached."""
+    out = str(tmp_path / "SCALE_r99.json")
+    d = _run_quick("scale_soak.py", out,
+                   extra=("--soak-workloads", "20000"))
+    assert d["quick"] is True
+    assert d["sizes"] == [1000, 4000]
+    assert d["parity"]["planes_identical_all"] is True
+    assert d["parity"]["decisions_identical_all"] is True
+    assert d["soak"]["completed"] is True
+    assert d["soak"]["wal"]["wal_commits"] > 0
+    # group commit: strictly fewer fsyncs than commits
+    assert d["soak"]["wal"]["wal_fsyncs"] < d["soak"]["wal"]["wal_commits"]
+    assert d["control"]["interleaved"] is True
+    # streaming must already beat the rebuild arm at 4k CQs
+    assert d["curve"][-1]["pack_speedup"] > 1.0
+    assert _validate(out) == []
+
+
 def test_chaos_soak_quick(tmp_path):
     out = str(tmp_path / "CHAOS_r99.json")
     d = _run_quick("chaos_soak.py", out)
