@@ -68,6 +68,7 @@ from kueue_tpu.api.types import (
     Workload,
 )
 from kueue_tpu.controller.driver import Driver
+from kueue_tpu.features import env_value
 
 BASELINE_WALL_S = 351.116          # default_rangespec.yaml avg
 BASELINE_ADMISSIONS_PER_S = 15000 / BASELINE_WALL_S
@@ -340,13 +341,12 @@ def _mesh_tail() -> dict:
     return {"n_devices": len(devs),
             "platform": devs[0].platform if devs else "none",
             "shards": max(1, _shards or int(
-                os.environ.get("KUEUE_TPU_SHARDS", "0") or 0))}
+                env_value("KUEUE_TPU_SHARDS") or 0))}
 
 
 def main():
     if ("--require-accel" in sys.argv[1:]
-            or os.environ.get("KUEUE_TPU_REQUIRE_ACCEL", "0")
-            not in ("", "0")):
+            or env_value("KUEUE_TPU_REQUIRE_ACCEL") not in ("", "0")):
         from kueue_tpu.perf.harness import require_accel_or_die
         require_accel_or_die()
     scale = float(os.environ.get("BENCH_SCALE", "1.0"))

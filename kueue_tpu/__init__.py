@@ -16,6 +16,8 @@ __version__ = "0.1.0"
 # compilecache.enable().  KUEUE_TPU_COMPILE_CACHE=0 restores full logs.
 import os as _os
 
-if _os.environ.get("KUEUE_TPU_COMPILE_CACHE") != "0":
+from .features import env_value as _env_value
+
+if _env_value("KUEUE_TPU_COMPILE_CACHE") != "0":
     _os.environ.setdefault("TF_CPP_MIN_LOG_LEVEL", "3")
 del _os

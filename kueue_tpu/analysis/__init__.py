@@ -1,0 +1,38 @@
+"""AST-level invariant lint for the kueue-tpu stack.
+
+The solver stack rests on a handful of invariants that, until this
+package, were only enforced dynamically (a 2-hour soak failure instead
+of a review-time error):
+
+- **kernel purity** — code traced by ``jax.jit``/``shard_map`` must be
+  a pure function of its tensors (``analysis.purity``);
+- **dtype discipline** — packed planes carry declared dtypes; an
+  accidental int64/float64 default on the transfer boundary defeats
+  the tightening contract (``analysis.dtypes``);
+- **WAL ordering** — every store mutation in the driver is journaled
+  first, and every ``wal.*`` chaos point sits between append and
+  mutation (``analysis.wal_order``);
+- **chaos-site registry** — documented, threaded and scenario-covered
+  injection sites agree exactly (``analysis.chaos_sites``);
+- **env-flag registry** — every ``KUEUE_TPU_*`` read goes through the
+  ``features.ENV_FLAGS`` table and appears in the README flag table
+  (``analysis.env_flags``).
+
+``scripts/lint_invariants.py`` is the CLI; ``run_all`` is the API.
+Grandfathered findings live in ``baseline.json`` next to this file —
+the baseline may only shrink (tests/test_static_analysis.py enforces
+both the zero-unsuppressed-findings and the shrink-only invariant).
+Everything here is stdlib-``ast`` only: no jax, no numpy, so the lint
+stays fast enough for tier-1.
+"""
+
+from .core import (  # noqa: F401
+    BASELINE_PATH,
+    Context,
+    Finding,
+    ParsedFile,
+    all_passes,
+    apply_baseline,
+    load_baseline,
+    run_all,
+)

@@ -1,0 +1,241 @@
+"""Kernel purity: no host effects reachable from jit/shard_map entry.
+
+Scope is deliberately *not* "all of ops/": those modules mix jitted
+kernels with host-side orchestration that legitimately reads clocks and
+env vars.  The pass finds jit/shard_map entry points, walks the
+intra-module call graph from them, and only code reachable from a
+traced entry is held to purity:
+
+- ``wall-clock``   calls through ``time``/``datetime``
+- ``stdlib-random``calls through ``random`` (or names imported from it)
+- ``np-random``    ``np.random.*`` (the unseeded global generator)
+- ``traced-coercion`` ``.item()`` / ``float(x)`` / ``bool(x)`` on
+  non-constant arguments (host round-trip of a traced value)
+- ``host-io``      ``open``/``print``/``input``, ``os.*`` calls
+- ``global-mutation`` ``global`` statements, or stores through a
+  module-level name (mutating trace-time state)
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Optional
+
+from .core import Context, Finding, FuncInfo, ParsedFile, dotted, \
+    index_functions
+
+RULE = "purity"
+
+_SCOPE_PREFIXES = ("kueue_tpu/ops/", "kueue_tpu/parallel/")
+
+
+def _in_scope(path: str) -> bool:
+    return path.startswith(_SCOPE_PREFIXES)
+
+
+def _module_imports(tree: ast.Module):
+    """(module alias -> module name, from-imported name -> module)."""
+    mod_alias: dict[str, str] = {}
+    from_name: dict[str, str] = {}
+    for node in ast.walk(tree):
+        if isinstance(node, ast.Import):
+            for a in node.names:
+                mod_alias[a.asname or a.name.split(".")[0]] = a.name
+        elif isinstance(node, ast.ImportFrom) and node.module:
+            for a in node.names:
+                from_name[a.asname or a.name] = node.module
+    return mod_alias, from_name
+
+
+def _is_jit_expr(node: ast.AST, from_name: dict[str, str]) -> bool:
+    """True for ``jax.jit`` / bare ``jit`` imported from jax."""
+    d = dotted(node)
+    if d in ("jax.jit", "jax.pjit", "pjit.pjit"):
+        return True
+    return d in ("jit", "pjit") and from_name.get(d, "").startswith("jax")
+
+
+def _is_shard_map(node: ast.AST) -> bool:
+    d = dotted(node)
+    return d is not None and d.split(".")[-1] == "shard_map"
+
+
+def _callee_roots(node: ast.AST) -> list[str]:
+    """Names a traced callable expression resolves to: a Name is itself;
+    a Lambda contributes every simple name it calls."""
+    if isinstance(node, ast.Name):
+        return [node.id]
+    if isinstance(node, ast.Lambda):
+        return [c.func.id for c in ast.walk(node.body)
+                if isinstance(c, ast.Call) and isinstance(c.func, ast.Name)]
+    return []
+
+
+def _entry_names(tree: ast.Module, from_name: dict[str, str]) -> set[str]:
+    """Simple names of functions that enter tracing: decorated defs and
+    ``jit(f)`` / ``partial(jit, ...)(f)`` / ``shard_map(f, ...)`` calls."""
+    roots: set[str] = set()
+    for node in ast.walk(tree):
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            for dec in node.decorator_list:
+                target = dec.func if isinstance(dec, ast.Call) else dec
+                if _is_jit_expr(target, from_name) or _is_shard_map(target):
+                    roots.add(node.name)
+                elif (isinstance(dec, ast.Call)
+                      and dotted(dec.func) in ("partial", "functools.partial")
+                      and dec.args
+                      and (_is_jit_expr(dec.args[0], from_name)
+                           or _is_shard_map(dec.args[0]))):
+                    roots.add(node.name)
+        elif isinstance(node, ast.Call):
+            fn = node.func
+            if (_is_jit_expr(fn, from_name) or _is_shard_map(fn)) and node.args:
+                roots.update(_callee_roots(node.args[0]))
+            # partial(jax.jit, ...)(f)
+            elif (isinstance(fn, ast.Call)
+                  and dotted(fn.func) in ("partial", "functools.partial")
+                  and fn.args
+                  and (_is_jit_expr(fn.args[0], from_name)
+                       or _is_shard_map(fn.args[0]))
+                  and node.args):
+                roots.update(_callee_roots(node.args[0]))
+    return roots
+
+
+def _reachable(tree: ast.Module, roots: set[str]) -> dict[str, FuncInfo]:
+    """Kernel scope: defs reachable from the entry names via simple-name
+    calls within this module."""
+    funcs = index_functions(tree)
+    by_simple: dict[str, list[FuncInfo]] = {}
+    for info in funcs.values():
+        by_simple.setdefault(info.qualname.split(".")[-1], []).append(info)
+
+    seen: dict[str, FuncInfo] = {}
+    frontier = list(roots)
+    while frontier:
+        name = frontier.pop()
+        for info in by_simple.get(name, []):
+            if info.qualname in seen:
+                continue
+            seen[info.qualname] = info
+            for call in ast.walk(info.node):
+                if isinstance(call, ast.Call) and isinstance(call.func,
+                                                             ast.Name):
+                    if call.func.id not in seen:
+                        frontier.append(call.func.id)
+    return seen
+
+
+def _module_level_names(tree: ast.Module) -> set[str]:
+    names: set[str] = set()
+    for node in tree.body:
+        targets = []
+        if isinstance(node, ast.Assign):
+            targets = node.targets
+        elif isinstance(node, (ast.AnnAssign, ast.AugAssign)):
+            targets = [node.target]
+        for t in targets:
+            if isinstance(t, ast.Name):
+                names.add(t.id)
+    return names
+
+
+def _check_kernel(pf: ParsedFile, info: FuncInfo, mod_alias: dict[str, str],
+                  from_name: dict[str, str], module_names: set[str],
+                  out: list[Finding]):
+    clock_mods = {a for a, m in mod_alias.items()
+                  if m in ("time", "datetime")}
+    rand_mods = {a for a, m in mod_alias.items() if m == "random"}
+    rand_names = {n for n, m in from_name.items() if m == "random"}
+    clock_names = {n for n, m in from_name.items()
+                   if m in ("time", "datetime")}
+    os_mods = {a for a, m in mod_alias.items() if m == "os"}
+    np_mods = {a for a, m in mod_alias.items() if m == "numpy"}
+
+    def emit(code: str, node: ast.AST, msg: str):
+        out.append(Finding(RULE, code, pf.path, node.lineno,
+                           info.qualname, msg))
+
+    # locals of this def shadow module globals for the mutation check
+    local_names = {a.arg for a in ast.walk(info.node)
+                   if isinstance(a, ast.arg)}
+    for n in ast.walk(info.node):
+        if isinstance(n, (ast.Assign, ast.AnnAssign, ast.AugAssign)):
+            ts = n.targets if isinstance(n, ast.Assign) else [n.target]
+            for t in ts:
+                if isinstance(t, ast.Name):
+                    local_names.add(t.id)
+
+    for node in ast.walk(info.node):
+        if isinstance(node, ast.Global):
+            emit("global-mutation", node,
+                 f"`global {', '.join(node.names)}` inside a traced "
+                 "function mutates module state at trace time")
+        elif isinstance(node, (ast.Assign, ast.AugAssign)):
+            targets = (node.targets if isinstance(node, ast.Assign)
+                       else [node.target])
+            for t in targets:
+                base = t
+                while isinstance(base, (ast.Subscript, ast.Attribute)):
+                    base = base.value
+                if (isinstance(base, ast.Name) and base.id in module_names
+                        and base.id not in local_names):
+                    emit("global-mutation", node,
+                         f"store through module-level `{base.id}` from "
+                         "traced code")
+        elif isinstance(node, ast.Call):
+            d = dotted(node.func)
+            root = d.split(".")[0] if d else None
+            if root in clock_mods or d in clock_names:
+                emit("wall-clock", node,
+                     f"wall-clock call `{d}()` in traced code")
+            elif (root in rand_mods or d in rand_names):
+                emit("stdlib-random", node,
+                     f"stdlib random call `{d}()` in traced code")
+            elif (d and root in np_mods
+                  and d.split(".")[1:2] == ["random"]):
+                emit("np-random", node,
+                     f"unseeded `{d}()` in traced code")
+            elif root in os_mods:
+                emit("host-io", node,
+                     f"host call `{d}()` in traced code")
+            elif isinstance(node.func, ast.Attribute) \
+                    and node.func.attr == "item" and not node.args:
+                emit("traced-coercion", node,
+                     "`.item()` forces a device->host round-trip of a "
+                     "traced value")
+            elif (isinstance(node.func, ast.Name)
+                  and node.func.id in ("float", "bool")
+                  and node.args
+                  and not isinstance(node.args[0], ast.Constant)):
+                emit("traced-coercion", node,
+                     f"`{node.func.id}()` coercion of a (potentially "
+                     "traced) value in traced code")
+            elif (isinstance(node.func, ast.Name)
+                  and node.func.id in ("open", "print", "input")):
+                emit("host-io", node,
+                     f"`{node.func.id}()` in traced code")
+
+
+def run(files: list[ParsedFile], ctx: Context) -> list[Finding]:
+    out: list[Finding] = []
+    for pf in files:
+        if not _in_scope(pf.path):
+            continue
+        mod_alias, from_name = _module_imports(pf.tree)
+        roots = _entry_names(pf.tree, from_name)
+        if not roots:
+            continue
+        module_names = _module_level_names(pf.tree)
+        for info in _reachable(pf.tree, roots).values():
+            _check_kernel(pf, info, mod_alias, from_name, module_names, out)
+    # a nested def reachable both via its parent's subtree and by name
+    # would double-report: keep the first finding per site
+    seen: set[tuple] = set()
+    deduped = []
+    for f in out:
+        site = (f.code, f.path, f.line)
+        if site not in seen:
+            seen.add(site)
+            deduped.append(f)
+    return deduped

@@ -14,6 +14,7 @@ import threading
 from typing import Optional
 
 from .. import hierarchy
+from ..features import env_value
 from ..api.types import (
     Admission,
     AdmissionCheck,
@@ -103,8 +104,8 @@ class Cache:
         self._rebuild_deferred = False
         self._rebuild_pending = False
         self._snap_cache: Optional[_SnapCache] = None
-        self._snap_incremental = os.environ.get(
-            "KUEUE_TPU_SNAP_INCREMENTAL", "1").lower() not in ("0", "false")
+        self._snap_incremental = env_value(
+            "KUEUE_TPU_SNAP_INCREMENTAL").lower() not in ("0", "false")
         self.snapshot_stats: dict[str, int] = {
             "snap_builds": 0, "snap_full": 0, "snap_incremental": 0,
             "snap_trees_recloned": 0, "snap_trees_reused": 0,

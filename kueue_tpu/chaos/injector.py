@@ -46,10 +46,11 @@ site                            effect at the call point
 
 from __future__ import annotations
 
-import os
 import random
 from dataclasses import dataclass, field
 from typing import Optional
+
+from ..features import env_value
 
 
 class InjectedCrash(RuntimeError):
@@ -160,7 +161,7 @@ def active() -> Optional[ChaosInjector]:
 def from_env() -> Optional[ChaosInjector]:
     """Install an injector seeded from ``KUEUE_TPU_CHAOS_SEED`` (unset
     or empty = chaos off).  The caller arms faults afterwards."""
-    seed = os.environ.get("KUEUE_TPU_CHAOS_SEED", "")
+    seed = env_value("KUEUE_TPU_CHAOS_SEED")
     if not seed:
         return None
     try:

@@ -35,6 +35,7 @@ from .api.types import (
     WorkloadPriorityClass,
 )
 from .controller.driver import Driver
+from .features import env_value
 
 VERSION = "0.1.0 (kueue reference parity ≈ v0.11)"
 STATE_FILE = "state.json"
@@ -503,8 +504,8 @@ def cmd_import(store: Store, args) -> int:
 def main(argv: list[str] | None = None) -> int:
     parser = argparse.ArgumentParser(
         prog="kueuectl", description="kueue-tpu control CLI")
-    parser.add_argument("--state-dir", default=os.environ.get(
-        "KUEUE_TPU_STATE", ".kueue-tpu"))
+    parser.add_argument("--state-dir",
+                        default=env_value("KUEUE_TPU_STATE"))
     sub = parser.add_subparsers(dest="command", required=True)
 
     p = sub.add_parser("apply", help="apply -f manifests")

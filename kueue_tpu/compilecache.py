@@ -27,6 +27,8 @@ from __future__ import annotations
 
 import os
 
+from .features import env_value
+
 _enabled_dir: str | None = None
 
 
@@ -36,7 +38,7 @@ def enable(cache_dir: str | None = None,
 
     Returns the cache directory, or None when disabled via env."""
     global _enabled_dir
-    env = os.environ.get("KUEUE_TPU_COMPILE_CACHE")
+    env = env_value("KUEUE_TPU_COMPILE_CACHE")
     if env == "0":
         return None
     if _enabled_dir is not None:

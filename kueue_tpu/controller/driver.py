@@ -137,11 +137,8 @@ class Driver:
     @staticmethod
     def _env_shards() -> int:
         """KUEUE_TPU_SHARDS=N activates sharded dispatch (0/1 = serial)."""
-        import os
-        try:
-            return int(os.environ.get("KUEUE_TPU_SHARDS", "0") or 0)
-        except ValueError:
-            return 0
+        from ..features import env_int
+        return env_int("KUEUE_TPU_SHARDS")
 
     @classmethod
     def from_config(cls, cfg, clock: Callable[[], float] = time.time,

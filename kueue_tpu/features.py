@@ -1,14 +1,23 @@
-"""Feature gates (reference pkg/features/kube_features.go:31-255).
+"""Feature gates and the environment-flag registry.
 
-Versioned defaults mirroring the reference at its snapshot (≈ v0.11):
+Feature gates (reference pkg/features/kube_features.go:31-255):
+versioned defaults mirroring the reference at its snapshot (≈ v0.11);
 each gate carries (default, stage, lock_to_default).  ``enabled(name)``
 is the runtime check; ``set_feature_gate_during_test`` is the test
 override (kube_features.go:257 SetFeatureGateDuringTest).
+
+``ENV_FLAGS`` is the single declared registry of every ``KUEUE_TPU_*``
+environment variable the stack reads.  All reads go through
+:func:`env_value` / :func:`env_int`, which refuse names missing from
+the registry — the static-analysis env pass (``analysis/env_flags.py``)
+flags any ad-hoc ``os.environ`` read of a ``KUEUE_TPU_*`` name and any
+drift between this table and the README flag table.
 """
 
 from __future__ import annotations
 
 import contextlib
+import os
 from dataclasses import dataclass
 
 
@@ -82,6 +91,89 @@ def set_feature_gates(gates: dict[str, bool]) -> None:
 
 def reset_feature_gates() -> None:
     _overrides.clear()
+
+
+# ---------------------------------------------------------------------------
+# Environment-flag registry
+# ---------------------------------------------------------------------------
+
+@dataclass(frozen=True)
+class EnvFlag:
+    """One declared ``KUEUE_TPU_*`` environment variable.
+
+    ``default`` is the *raw string* handed back when the variable is
+    unset — call sites keep their own parse/compare idiom (``!= "0"``,
+    ``int(...)``, truthiness) so centralizing the read cannot change
+    semantics.  ``type`` is documentation for the README table."""
+    name: str
+    default: str
+    type: str                 # bool | int | str | path
+    doc: str
+
+
+# Every KUEUE_TPU_* variable the stack reads, in one place.  The env
+# pass fails the lint if a read bypasses this table or if the README
+# "Environment flags" table disagrees with it.
+ENV_FLAGS: dict[str, EnvFlag] = {f.name: f for f in (
+    EnvFlag("KUEUE_TPU_STATE", ".kueue-tpu", "path",
+            "CLI state directory (durable store + WAL)."),
+    EnvFlag("KUEUE_TPU_SHARDS", "0", "int",
+            "Shard count for the (\"cq\",) mesh; 0 = serial path."),
+    EnvFlag("KUEUE_TPU_ACCEL_MIN_HEADS", "512", "int",
+            "Min solver heads before dispatching to the accelerator."),
+    EnvFlag("KUEUE_TPU_REQUIRE_ACCEL", "0", "bool",
+            "Die rather than fall back to CPU (perf harness guard)."),
+    EnvFlag("KUEUE_TPU_STREAM_PACK", "1", "bool",
+            "Streaming delta-pack of the persistent packed universe."),
+    EnvFlag("KUEUE_TPU_PACK_TIGHTEN", "1", "bool",
+            "Dtype-tighten launch planes (int32 -> int16/int8)."),
+    EnvFlag("KUEUE_TPU_RESIDENT", "1", "bool",
+            "Shard-resident burst state planes on the device mesh."),
+    EnvFlag("KUEUE_TPU_RESIDENT_VERIFY", "", "bool",
+            "Cross-check resident planes against host scatter."),
+    EnvFlag("KUEUE_TPU_SNAP_INCREMENTAL", "1", "bool",
+            "Incremental O(dirty) snapshot maintenance in the cache."),
+    EnvFlag("KUEUE_TPU_COMPILE_CACHE", "", "path",
+            "XLA compile-cache dir; \"0\" disables, empty = default."),
+    EnvFlag("KUEUE_TPU_WAL_COMMIT_EVERY", "1", "int",
+            "CycleWAL group-commit interval (ops per fsync)."),
+    EnvFlag("KUEUE_TPU_CHAOS_SEED", "", "int",
+            "Seed the process-default chaos injector; empty = off."),
+    EnvFlag("KUEUE_TPU_SCALE_SEED", "1307", "int",
+            "Seed for the scale-soak scenario generator."),
+    EnvFlag("KUEUE_TPU_TRAFFIC_SEED", "1109", "int",
+            "Seed for the open-loop traffic soak."),
+)}
+
+
+class UnknownEnvFlagError(KeyError):
+    pass
+
+
+def env_value(name: str, default: str | None = None) -> str:
+    """Read a registered ``KUEUE_TPU_*`` variable as a raw string.
+
+    ``default`` overrides the registry default for call sites whose
+    fallback is context-dependent (e.g. the soaks); it must still name
+    a registered flag."""
+    spec = ENV_FLAGS.get(name)
+    if spec is None:
+        raise UnknownEnvFlagError(name)
+    return os.environ.get(name, spec.default if default is None else default)
+
+
+def env_int(name: str, default: int | None = None) -> int:
+    """Read a registered flag as an int; malformed values fall back to
+    the (registry or caller) default instead of raising."""
+    spec = ENV_FLAGS.get(name)
+    if spec is None:
+        raise UnknownEnvFlagError(name)
+    fallback = spec.default if default is None else str(default)
+    raw = os.environ.get(name, fallback) or fallback
+    try:
+        return int(raw)
+    except ValueError:
+        return int(fallback or 0)
 
 
 @contextlib.contextmanager

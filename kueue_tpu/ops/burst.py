@@ -78,6 +78,7 @@ import numpy as np
 from .quota_kernel import available_all, available_at
 from .cycle import add_usage_chain_batched
 from ..chaos import injector as _chaos
+from ..features import env_value
 
 INF_I32 = np.int32(2**31 - 1)
 I32_MAX = 2**31 - 1
@@ -1715,7 +1716,7 @@ def pack_burst_cached(structure, queues, cache, scheduler, clock,
     classic path.  Both paths share the return contract and produce
     bit-identical plans (test-enforced)."""
     import os
-    if (os.environ.get("KUEUE_TPU_STREAM_PACK", "1") != "0"
+    if (env_value("KUEUE_TPU_STREAM_PACK") != "0"
             and os.environ.get("KUEUE_BURST_DELTA_PACK", "1") != "0"
             and not getattr(structure, "_stream_poison", False)):
         from .stream_pack import pack_burst_streaming
@@ -2163,8 +2164,7 @@ class BurstSolver:
         st = plan.structure
         dev = self._device()
         a = plan.arrays
-        import os as _os
-        if _os.environ.get("KUEUE_TPU_PACK_TIGHTEN", "1") != "0":
+        if env_value("KUEUE_TPU_PACK_TIGHTEN") != "0":
             # narrow the rank/index/request planes at the serial
             # transfer boundary only — plan.arrays keeps the reference
             # int32 dtypes (parity tests, resident scatter); the kernel
@@ -2333,7 +2333,7 @@ class BurstSolver:
             stats["burst_resident_hits"] += 1
             stats["burst_resident_scatter_s"] += (
                 _time.perf_counter() - t0)
-            if os.environ.get("KUEUE_TPU_RESIDENT_VERIFY"):
+            if env_value("KUEUE_TPU_RESIDENT_VERIFY"):
                 for name in SCATTER_PLANES:
                     want = layout.permute_rows(a[name], _C_FILLS[name])
                     if not np.array_equal(np.asarray(planes[name]),
@@ -2385,7 +2385,7 @@ class BurstSolver:
         layout = self._layout_for(plan)
         timers = self.stats.get("burst_shard_pack_s")
         a = None
-        if os.environ.get("KUEUE_TPU_RESIDENT", "1") != "0":
+        if env_value("KUEUE_TPU_RESIDENT") != "0":
             cached = getattr(plan, "_resident_args", None)
             if cached is not None and cached[0] is layout:
                 a = cached[1]

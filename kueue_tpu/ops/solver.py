@@ -40,6 +40,7 @@ from typing import Optional
 import numpy as np
 
 from ..api.types import FlavorFungibility, FlavorFungibilityPolicy
+from ..features import env_value
 from ..cache.snapshot import Snapshot
 from ..workload import Info, Ordering
 from ..scheduler.flavorassigner import (
@@ -157,8 +158,8 @@ class CycleSolver:
             backend = "auto"
         self.backend = backend
         if accel_min_heads is None:
-            accel_min_heads = int(os.environ.get(
-                "KUEUE_TPU_ACCEL_MIN_HEADS", "512"))
+            accel_min_heads = int(
+                env_value("KUEUE_TPU_ACCEL_MIN_HEADS"))
         self.accel_min_heads = accel_min_heads
         # Disjoint cycle counters: every cycle with heads lands in exactly
         # one of full/classify/host (bench derives shares from these).

@@ -84,7 +84,7 @@ def _enc_str(arr: np.ndarray, width: int) -> np.ndarray:
     """ASCII-encode a unicode array into fixed-width bytes whose memcmp
     order equals the unicode code-point order; bail when a value can't
     be represented."""
-    a = np.asarray(arr)
+    a = np.asarray(arr, dtype=np.str_)
     if a.size and int(np.char.str_len(a).max(initial=0)) > width:
         raise _StreamBail(f"key longer than {width} bytes")
     try:
@@ -537,22 +537,22 @@ def _init_full(st, queues, cache, scheduler, key, min_m, window, arena,
     ci_sorted = ci_a[order]
     first = np.ones(n, dtype=bool)
     first[1:] = ci_sorted[1:] != ci_sorted[:-1]
-    seg_start = np.maximum.accumulate(np.where(first, np.arange(n), 0))
-    mi_sorted = (np.arange(n) - seg_start).astype(np.int64)
-    mi_a = np.empty(n, dtype=np.int64)
+    idx = np.arange(n, dtype=np.int32)
+    seg_start = np.maximum.accumulate(np.where(first, idx, np.int32(0)))
+    mi_sorted = idx - seg_start
+    mi_a = np.empty(n, dtype=np.int32)
     mi_a[order] = mi_sorted
-    mi_a32 = mi_a.astype(np.int32)
 
     state.mi_of = {}
     state.kb_of = {}
     for ci in range(C):
         lo, hi = int(bounds[ci]), int(bounds[ci + 1])
-        state.mi_of[ci] = mi_a32[lo:hi]
+        state.mi_of[ci] = mi_a[lo:hi]
         state.kb_of[ci] = kb_all[lo:hi]
 
     if n:
         views["wl_req"][ci_a, mi_a] = cat("req", np.int32)
-        views["wl_rank"][ci_a, mi_a] = mi_a32
+        views["wl_rank"][ci_a, mi_a] = mi_a
         views["wl_prio"][ci_a, mi_a] = np.clip(
             prio_a, -_b.I32_MAX, _b.I32_MAX)
         parked_a = cat("parked", bool)
@@ -575,12 +575,12 @@ def _init_full(st, queues, cache, scheduler, key, min_m, window, arena,
     # maintained global orders + their dense rank planes
     state.crank = _Order(_SKEY_S)
     state.crank.set(_crank_skey(prio_a, ts_a, pos_a, kb_all),
-                    ci_a, mi_a32)
+                    ci_a, mi_a)
     if n:
         views["wl_cycle_rank"][state.crank.ci, state.crank.mi] = \
             np.arange(n, dtype=np.int32)
     state.uord = _Order(f"S{_UID_BYTES}")
-    state.uord.set(ub_all, ci_a, mi_a32)
+    state.uord.set(ub_all, ci_a, mi_a)
     if n:
         views["wl_uidrank"][state.uord.ci, state.uord.mi] = \
             np.arange(n, dtype=np.int32)
@@ -589,7 +589,7 @@ def _init_full(st, queues, cache, scheduler, key, min_m, window, arena,
     aord = np.argsort(ats, kind="stable")
     state.adm_ts = ats[aord]
     state.adm_ci = ci_a[am][aord]
-    state.adm_mi = mi_a32[am][aord]
+    state.adm_mi = mi_a[am][aord]
     if len(state.adm_ts):
         uniq = np.unique(state.adm_ts)
         state.adm_seq_cache = (np.searchsorted(uniq, state.adm_ts)

@@ -69,6 +69,7 @@ import os
 from typing import Optional
 
 from ..chaos import injector as _chaos
+from ..features import env_int
 
 
 class PackJournal:
@@ -255,11 +256,7 @@ class CycleWAL:
         self.batches: list[list[dict]] = []   # committed batches
         self._open: Optional[list[dict]] = None
         if commit_every is None:
-            try:
-                commit_every = int(os.environ.get(
-                    "KUEUE_TPU_WAL_COMMIT_EVERY", "1"))
-            except ValueError:
-                commit_every = 1
+            commit_every = env_int("KUEUE_TPU_WAL_COMMIT_EVERY")
         self.commit_every = max(1, commit_every)
         self.fsync = fsync
         self.compact_every = max(0, compact_every)

@@ -83,6 +83,7 @@ from kueue_tpu.api.types import (
 from kueue_tpu.chaos import injector as chaos
 from kueue_tpu.chaos.injector import ChaosInjector, InjectedCrash
 from kueue_tpu.controller.driver import Driver
+from kueue_tpu.features import env_value
 from kueue_tpu.ops.burst import BurstSolver
 from kueue_tpu.perf.harness import chaos_report
 from kueue_tpu.remote import ChaosWorkerClient, LocalWorkerClient
@@ -624,8 +625,8 @@ def main() -> int:
     ap.add_argument("--devices", type=int, default=8,
                     help="virtual device count (consumed pre-import)")
     ap.add_argument("--seed", type=int,
-                    default=int(os.environ.get("KUEUE_TPU_CHAOS_SEED",
-                                               "1009")))
+                    default=int(env_value("KUEUE_TPU_CHAOS_SEED",
+                                          "1009")))
     ap.add_argument("--quick", action="store_true",
                     help="tiny cluster for a fast functional pass")
     ap.add_argument("--only", default=None,

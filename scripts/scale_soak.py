@@ -55,6 +55,7 @@ from kueue_tpu.api.types import (
     PodSet,
 )
 from kueue_tpu.controller.driver import Driver
+from kueue_tpu.features import env_value
 from kueue_tpu.ops.burst import pack_burst, pack_burst_cached
 from kueue_tpu.ops.packing import TightenState, tighten_arrays
 from kueue_tpu.perf.harness import ab_block
@@ -407,8 +408,7 @@ def main() -> int:
     ap.add_argument("--sizes", default="",
                     help="comma-separated CQ universe sizes")
     ap.add_argument("--seed", type=int,
-                    default=int(os.environ.get("KUEUE_TPU_SCALE_SEED",
-                                               "1307")))
+                    default=int(env_value("KUEUE_TPU_SCALE_SEED")))
     ap.add_argument("--boundaries", type=int, default=8,
                     help="measured pack boundaries per size")
     ap.add_argument("--rounds", type=int, default=3,
@@ -434,8 +434,7 @@ def main() -> int:
     soak_target = args.soak_workloads or (100_000 if args.quick
                                           else 10_000_000)
     soak_cqs = sizes[-1]
-    commit_every = int(os.environ.get("KUEUE_TPU_WAL_COMMIT_EVERY",
-                                      "64"))
+    commit_every = int(env_value("KUEUE_TPU_WAL_COMMIT_EVERY", "64"))
     t_start = time.perf_counter()
     log(f"scale soak: sizes={sizes} boundaries={boundaries} "
         f"churn={args.churn} soak={soak_target}@{soak_cqs}cqs "

@@ -79,6 +79,7 @@ from kueue_tpu.api.types import (
     ResourceQuota,
 )
 from kueue_tpu.controller.driver import Driver
+from kueue_tpu.features import env_value
 from kueue_tpu.perf.harness import ab_block
 from kueue_tpu.remote import LocalWorkerClient
 from kueue_tpu.traffic import (
@@ -213,8 +214,7 @@ def main() -> int:
     ap.add_argument("--shards", type=int, default=8,
                     help="sharded-arm mesh size (consumed pre-import)")
     ap.add_argument("--seed", type=int,
-                    default=int(os.environ.get("KUEUE_TPU_TRAFFIC_SEED",
-                                               "1109")))
+                    default=int(env_value("KUEUE_TPU_TRAFFIC_SEED")))
     ap.add_argument("--duration", type=float, default=30.0,
                     help="virtual seconds per probe")
     ap.add_argument("--slo", type=float, default=8.0,

@@ -25,6 +25,12 @@ unreadable number.  Checks are tiered:
                      ``snapshot_counters`` host-cost block.
   NORTHSTAR_* /
   MULTICHIP_r08+   — additionally: ``metric`` + numeric ``value``.
+  LINT_*           — additionally: the five named analysis passes, a
+                     ``findings`` list whose length equals ``value``,
+                     ``ok`` consistent with findings/stale entries, a
+                     strictly-shrinking baseline
+                     (``baseline_entries`` < ``first_full_run_findings``),
+                     and a sub-10s ``elapsed_s`` (the lint is tier-1).
   MULTICHIP_r10+   — additionally: at least one ``crossover`` block
                      (top level or per-``runs`` entry) whose ``curve``
                      lists one entry per shard arm with int ``shards``,
@@ -360,10 +366,61 @@ def _check_traffic(d, path, out):
         _err(out, path, "missing 'snapshot_counters' object")
 
 
+_LINT_PASSES = ("purity", "dtype", "wal-order", "chaos-sites",
+                "env-flags")
+
+
+def _check_lint(d, path, out):
+    """LINT_* invariant-lint artifacts (scripts/lint_invariants.py
+    --artifact): all five passes ran, the finding count matches the
+    headline 'value', the ok verdict matches the findings/stale state,
+    the baseline only ever shrinks, and the run stayed tier-1 fast."""
+    passes = d.get("passes")
+    names = [p.get("name") for p in passes] \
+        if isinstance(passes, list) \
+        and all(isinstance(p, dict) for p in passes) else None
+    if names != list(_LINT_PASSES):
+        _err(out, path, f"'passes' must name exactly {_LINT_PASSES}, "
+             f"in order (got {names})")
+    findings = d.get("findings")
+    if not isinstance(findings, list):
+        _err(out, path, "'findings' must be a list")
+        findings = []
+    if isinstance(d.get("value"), (int, float)) \
+            and d["value"] != len(findings):
+        _err(out, path, f"'value'={d['value']} but {len(findings)} "
+             "findings listed")
+    stale = d.get("stale_baseline")
+    if not isinstance(stale, list):
+        _err(out, path, "'stale_baseline' must be a list")
+        stale = []
+    ok = d.get("ok")
+    if not isinstance(ok, bool):
+        _err(out, path, "missing bool 'ok'")
+    elif ok != (not findings and not stale):
+        _err(out, path, f"'ok'={ok} inconsistent with "
+             f"{len(findings)} findings / {len(stale)} stale entries")
+    n_base = d.get("baseline_entries")
+    first = d.get("first_full_run_findings")
+    if not isinstance(n_base, int) or not isinstance(first, int):
+        _err(out, path, "missing int 'baseline_entries' / "
+             "'first_full_run_findings'")
+    elif not n_base < first:
+        _err(out, path, f"baseline must shrink: "
+             f"baseline_entries={n_base} vs first full run={first}")
+    el = d.get("elapsed_s")
+    if not isinstance(el, (int, float)):
+        _err(out, path, "missing numeric 'elapsed_s'")
+    elif el >= 10.0:
+        _err(out, path, f"'elapsed_s'={el} breaks the <10s tier-1 "
+             "budget")
+
+
 # generator scripts that postdate the schema convention (metric+value
 # at top level); older BENCH_/MULTICHIP_r01-05 wrappers predate it and
 # only get the common checks
-_STRICT_PREFIXES = ("NORTHSTAR_", "CHAOS_", "TRAFFIC_", "SCALE_")
+_STRICT_PREFIXES = ("NORTHSTAR_", "CHAOS_", "TRAFFIC_", "SCALE_",
+                    "LINT_")
 
 
 def validate(path: str) -> list[str]:
@@ -388,6 +445,10 @@ def validate(path: str) -> list[str]:
     # artifact even if the file was renamed
     if base.startswith("SCALE_") or ("soak" in d and "parity" in d):
         _check_scale(d, path, out)
+    # by name or by shape: a stale_baseline key marks an invariant-lint
+    # record even if the file was renamed
+    if base.startswith("LINT_") or "stale_baseline" in d:
+        _check_lint(d, path, out)
     m = re.match(r"MULTICHIP_R(\d+)", base)
     if base.startswith(_STRICT_PREFIXES) or (m and int(m.group(1)) >= 8):
         _check_metric_value(d, path, out)

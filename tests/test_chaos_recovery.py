@@ -285,6 +285,50 @@ def test_crash_mid_evict_replays_requeue_and_eviction():
     assert full_state(d2) == full_state(dc)
 
 
+def test_crash_mid_finish_replays_wal_tail():
+    """Crash between the finish op's journal write and the condition
+    flips: replay must finish the workload exactly once and release its
+    quota, matching an uncrashed control driver."""
+    def mk_driver(clock):
+        d = Driver(clock=clock)
+        simple_cluster(n_cohorts=1, cqs=1)(d)
+        d.create_workload(mk("job", "lq-0-0", 1000, t=1.0))
+        return d
+
+    clock_c, clock_x = FakeClock(), FakeClock()
+    dc = mk_driver(clock_c)
+    dc.run_until_settled()
+    assert "default/job" in dc.admitted_keys()
+    clock_c.tick(5.0)
+    dc.finish_workloads(["default/job"], message="done")
+
+    d1 = mk_driver(clock_x)
+    wal = CycleWAL()
+    d1.attach_wal(wal)
+    d1.run_until_settled()
+    clock_x.tick(5.0)
+    chaos.install(ChaosInjector(seed=2)).arm("wal.finish", at=1)
+    with pytest.raises(InjectedCrash):
+        d1.finish_workloads(["default/job"], message="done")
+    chaos.clear()
+    assert [op["op"] for op in wal.tail] == ["finish"]
+    assert not d1.workloads["default/job"].is_finished, \
+        "the crash must land between journal append and mutation"
+
+    d2 = Driver(clock=clock_x)
+    simple_cluster(n_cohorts=1, cqs=1)(d2)
+    replayed = d2.recover_from(d1.workloads.values(), wal)
+    assert replayed >= 1
+    assert d2.workloads["default/job"].is_finished
+    assert full_state(d2) == full_state(dc)
+    # the freed quota is actually reusable after recovery
+    for d in (dc, d2):
+        d.create_workload(mk("next", "lq-0-0", 1000, t=10.0))
+        d.run_until_settled()
+        assert "default/next" in d.admitted_keys()
+    assert full_state(d2) == full_state(dc)
+
+
 # ---------------------------------------------------------------------------
 # Crash/recover parity: fused burst path
 # ---------------------------------------------------------------------------
@@ -624,10 +668,12 @@ def test_chaos_worker_client_watch_partition_is_raw():
 # ---------------------------------------------------------------------------
 
 def test_injector_is_deterministic_under_seed():
+    # armed at a real site: the chaos-sites lint rejects names no
+    # injection point answers to (a typo'd arm would test nothing)
     def run(seed):
         inj = ChaosInjector(seed=seed)
-        inj.arm("x", prob=0.3, times=50, action="tick")
-        return [inj.hit("x") is not None for _ in range(200)]
+        inj.arm("cycle.start", prob=0.3, times=50, action="tick")
+        return [inj.hit("cycle.start") is not None for _ in range(200)]
 
     a, b = run(7), run(7)
     assert a == b and any(a)
