@@ -45,8 +45,15 @@ class WorkerCluster:
     ``client`` — any transport client (kueue_tpu.remote.HttpWorkerClient
     for a real process/socket boundary).  Reconnection follows the
     reference's exponential retry (multikueuecluster.go:67 retryAfter,
-    :134-226 watch re-establishment): a failed operation marks the
-    cluster lost; health probes retry with doubling backoff."""
+    :134-226 watch re-establishment) with a half-open circuit: a failed
+    operation marks the cluster lost; health probes retry with doubling
+    backoff; a *passing* probe only opens a half-open trial window —
+    the controller must complete the rejoin reconciliation over the
+    real API before ``reconnect()`` closes the circuit, and a failure
+    during the trial re-opens it with the backoff escalated (a flapping
+    worker never gets a fresh budget per flap).  ``reconnect_budget``
+    > 0 caps total probes before the cluster is declared permanently
+    failed."""
     name: str
     driver: object = None             # in-process Driver (optional)
     client: object = None             # transport client
@@ -55,6 +62,10 @@ class WorkerCluster:
     next_retry: float = 0.0
     retry_backoff: float = RETRY_BASE_S
     watch: object = None              # remote.WatchLoop when streaming
+    half_open: bool = False           # probe passed, rejoin unproven
+    reconnect_attempts: int = 0       # probes since the cluster went lost
+    reconnect_budget: int = 0         # 0 = unlimited probes
+    failed_permanently: bool = False
 
     def __post_init__(self):
         if self.client is None and self.driver is not None:
@@ -62,6 +73,16 @@ class WorkerCluster:
             self.client = LocalWorkerClient(self.driver)
 
     def mark_lost(self, now: float) -> None:
+        if self.half_open:
+            # a half-open trial failed: keep escalating the backoff
+            # instead of resetting it
+            self.half_open = False
+            self.active = False
+            if self.lost_since is None:
+                self.lost_since = now
+            self.retry_backoff = min(self.retry_backoff * 2.0, RETRY_MAX_S)
+            self.next_retry = now + self.retry_backoff
+            return
         if self.active:
             self.active = False
             self.lost_since = now
@@ -69,20 +90,31 @@ class WorkerCluster:
             self.next_retry = now + self.retry_backoff
 
     def try_reconnect(self, now: float) -> bool:
-        """Health-probe with exponential backoff; True on reconnect."""
-        if self.active or now < self.next_retry:
+        """Half-open health probe with exponential backoff and a probe
+        budget.  True means the probe passed and the trial window is
+        open — NOT that the cluster is active again; the caller runs
+        the rejoin reconciliation and calls ``reconnect()`` (or
+        ``mark_lost()`` on failure) to settle the circuit."""
+        if self.active or self.failed_permanently or now < self.next_retry:
             return False
+        self.reconnect_attempts += 1
         if self.client.healthy():
-            self.reconnect()
+            self.half_open = True
             return True
+        if (self.reconnect_budget
+                and self.reconnect_attempts >= self.reconnect_budget):
+            self.failed_permanently = True
+            return False
         self.retry_backoff = min(self.retry_backoff * 2.0, RETRY_MAX_S)
         self.next_retry = now + self.retry_backoff
         return False
 
     def reconnect(self) -> None:
         self.active = True
+        self.half_open = False
         self.lost_since = None
         self.retry_backoff = RETRY_BASE_S
+        self.reconnect_attempts = 0
 
 
 @dataclass
@@ -177,10 +209,15 @@ class MultiKueueController:
                 if kind == "__lost__":
                     cluster.mark_lost(now)
                 elif kind == "__reconnected__":
-                    was_lost = not cluster.active
-                    cluster.reconnect()
-                    if was_lost:
-                        self._flush_pending_deletes(cname)
+                    if cluster.active:
+                        cluster.reconnect()   # refresh backoff state
+                    else:
+                        # the stream is back: treat it as a passing
+                        # half-open probe — the rejoin reconciliation
+                        # must prove the worker over the real API
+                        # before the cluster reactivates
+                        cluster.half_open = True
+                        self.reconcile_rejoined(cname)
                 elif kind == "__resync__":
                     # fresh worker epoch: the remote may have lost every
                     # mirror — resync everything tied to this cluster
@@ -205,7 +242,7 @@ class MultiKueueController:
             # watch stream (a separate connection) stays healthy and so
             # never emits a __reconnected__ marker
             if not cluster.active and cluster.try_reconnect(now):
-                self._flush_pending_deletes(name)
+                self.reconcile_rejoined(name)
             if (not cluster.active and cluster.lost_since is not None
                     and now - cluster.lost_since > self.worker_lost_timeout):
                 self._eject_cluster(name)
@@ -362,26 +399,71 @@ class MultiKueueController:
         if not cluster.active:
             self.pending_deletes.setdefault(cname, set()).add(key)
 
-    def _flush_pending_deletes(self, cname: str) -> None:
-        """A reconnected worker may hold mirrors whose deletes were lost
-        while it was unreachable — its daemon could even have admitted
-        them; delete them before anything else dispatches."""
+    def reconcile_rejoined(self, cname: str) -> bool:
+        """WAL-consistent rejoin reconciliation — the half-open trial.
+
+        The manager's journal-recovered store plus the assignment map
+        rebuilt from it are its durable intent; a rejoining worker's
+        listing is the actual state.  Replaying one against the other
+        resolves every nominate/admit race a partition can leave:
+
+        - mirrors whose deletes were lost while the worker was
+          unreachable (its daemon may even have admitted them) die
+          before anything else dispatches — the no-double-admission
+          guarantee on rejoin;
+        - mirrors still in a live nomination or assignment are kept
+          (the normal sync resumes them), as are finished-winner
+          records whose manager workload also finished;
+        - assignments pointing at this worker whose mirror vanished
+          (the worker restarted empty) reset for re-dispatch.
+
+        Runs while the cluster is half-open: any transport failure
+        aborts back to lost with the backoff escalated (the circuit
+        re-opens); only a clean pass closes it via ``reconnect()``.
+        Returns True when the cluster is active again."""
         cluster = self.clusters.get(cname)
-        pending = self.pending_deletes.get(cname)
-        if cluster is None or not pending:
-            return
-        for key in list(pending):
-            # keep the mirror if it is (again) this worker's assignment
-            asg = self.assignments.get(key)
-            if asg is not None and asg.cluster == cname:
+        if cluster is None or cluster.active:
+            return cluster is not None and cluster.active
+        from ..remote import ConnectionLost
+        pending = self.pending_deletes.get(cname, set())
+        try:
+            listing = cluster.client.list_workloads()
+            for key in sorted(listing):
+                finished = listing[key]
+                asg = self.assignments.get(key)
+                wl = self.manager.workloads.get(key)
+                keep_assigned = (
+                    asg is not None and wl is not None
+                    and self._relevant(wl)
+                    and (asg.cluster == cname
+                         or (not asg.cluster and cname in asg.nominated)))
+                keep_record = (finished and wl is not None
+                               and wl.is_finished and key not in pending)
+                if keep_assigned or keep_record:
+                    pending.discard(key)
+                    continue
+                worker_jm = self.worker_jobs.get(cname)
+                if worker_jm is not None:
+                    for jkey, job in list(worker_jm.jobs.items()):
+                        if worker_jm.reconciler.workload_key_for(job) == key:
+                            worker_jm.delete(jkey)
+                cluster.client.delete_workload(key)
                 pending.discard(key)
-                continue
-            self._worker_op(cluster, cluster.client.delete_workload, key)
-            if not cluster.active:
-                return   # dropped again; retry on the next reconnect
-            pending.discard(key)
-        if not pending:
-            self.pending_deletes.pop(cname, None)
+            # deletes queued for mirrors the worker no longer holds are moot
+            for key in list(pending):
+                if key not in listing:
+                    pending.discard(key)
+            # the partition may have eaten this worker's mirrors: anything
+            # assigned here but gone must re-dispatch
+            for key, asg in list(self.assignments.items()):
+                if asg.cluster == cname and key not in listing:
+                    self._reset(key)
+        except ConnectionLost:
+            cluster.mark_lost(self.manager.clock())
+            return False
+        self.pending_deletes.pop(cname, None)
+        cluster.reconnect()
+        return True
 
     def _cleanup(self, key: str) -> None:
         asg = self.assignments.pop(key, None)
@@ -400,10 +482,52 @@ class MultiKueueController:
 
     def _eject_cluster(self, cname: str) -> None:
         """Worker lost beyond timeout: requeue everything assigned to it
-        (workload.go workerLostTimeout ejection)."""
+        (workload.go workerLostTimeout ejection) and queue deletes for
+        every mirror it may still hold — if the worker ever rejoins,
+        its stale mirrors must die before they can double-admit against
+        the re-dispatched assignment."""
         for key, asg in list(self.assignments.items()):
             if asg.cluster == cname or cname in asg.nominated:
+                self.pending_deletes.setdefault(cname, set()).add(key)
                 self._reset(key)
+
+    def recover_assignments(self) -> int:
+        """Rebuild the assignment map after a manager restart
+        (Driver.recover_from): the map itself is in-memory, but every
+        fact it encodes is recoverable — the journal-recovered store
+        says which workloads carry this check, and the workers' actual
+        listings say who holds the mirror.  A READY check with multiple
+        holders keeps the first in config order and deletes the rest
+        (the same winner the original selection would have picked).
+        Returns the number of assignments restored."""
+        restored = 0
+        listings: dict[str, dict[str, bool]] = {}
+        for cname, cluster in self.clusters.items():
+            if not cluster.active:
+                continue
+            out = self._worker_op(cluster, cluster.client.list_workloads,
+                                  default=None)
+            if out is not None:
+                listings[cname] = out
+        for key, wl in list(self.manager.workloads.items()):
+            if key in self.assignments or not self._relevant(wl):
+                continue
+            holders = [c for c in self.config.clusters
+                       if key in listings.get(c, {})]
+            if not holders:
+                continue
+            state = wl.admission_check_states[self.check_name].state
+            if state == AdmissionCheckState.READY:
+                winner = holders[0]
+                self.assignments[key] = _Assignment(cluster=winner,
+                                                    nominated=[winner])
+                for cname in holders[1:]:
+                    self._delete_remote(cname, key)
+            else:
+                self.assignments[key] = _Assignment(cluster="",
+                                                    nominated=holders)
+            restored += 1
+        return restored
 
     # ------------------------------------------------------------------
 

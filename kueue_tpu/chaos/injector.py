@@ -24,6 +24,9 @@ site                            effect at the call point
                                 before the status mutations
 ``wal.finish``                  crash after the finish op is journaled but
                                 before the conditions flip
+``wal.requeue``                 crash after the requeue-backoff op is
+                                journaled but before the requeue state and
+                                eviction land
 ``wal.compact``                 crash mid-compaction: the checkpoint temp
                                 file is written and fsynced but the atomic
                                 rename has not happened (recovery reads
@@ -38,6 +41,17 @@ site                            effect at the call point
 ``remote.duplicate``            issue a remote mutation twice
 ``remote.partition``            fail the next ``times`` remote calls with
                                 ConnectionLost (healed by backoff retry)
+``remote.duplicate_event``      re-deliver a watch batch: events are pushed
+                                but the resume token does not advance, so
+                                the next poll replays the same batch
+``fed.partition``               sever the payload worker clusters from the
+                                federation sim for ``payload`` steps (every
+                                client op raises ConnectionLost)
+``fed.worker_crash``            kill the payload worker mid-admission (WAL
+                                tail journaled but unapplied) and recover
+                                it from its journal within the same step
+``fed.cluster_loss``            sever the payload worker cluster forever
+                                (drives the eject/re-dispatch path)
 ==============================  =============================================
 
 ``KUEUE_TPU_CHAOS_SEED`` seeds the process-default injector (see

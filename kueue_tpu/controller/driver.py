@@ -45,6 +45,7 @@ from ..workload import (
     Info,
     InfoOptions,
     Ordering,
+    next_requeue_state,
     set_finished_condition,
     set_requeued_condition,
     sync_admitted_condition,
@@ -653,14 +654,15 @@ class Driver:
             return
         cfg = self.wait_for_pods_ready
         now = self.clock()
+        if self._wal is not None:
+            count, requeue_at = next_requeue_state(
+                wl, cfg.requeuing_backoff_base_seconds,
+                cfg.requeuing_backoff_max_seconds, now)
+            self._wal.log(_journal.requeue_op(key, count, requeue_at))
+        if _chaos.ACTIVE is not None:
+            _chaos.ACTIVE.crashpoint("wal.requeue")
         update_requeue_state(wl, cfg.requeuing_backoff_base_seconds,
                              cfg.requeuing_backoff_max_seconds, now)
-        if self._wal is not None:
-            # logged post-mutation: the backoff math is deterministic and
-            # no crash site sits between this update and the eviction
-            # below, so replay's count guard keeps it exactly-once
-            self._wal.log(_journal.requeue_op(
-                key, wl.requeue_state.count, wl.requeue_state.requeue_at))
         limit = cfg.requeuing_backoff_limit_count
         if limit is not None and wl.requeue_state.count > limit:
             self.deactivate_workload(key)

@@ -143,6 +143,13 @@ ENV_FLAGS: dict[str, EnvFlag] = {f.name: f for f in (
             "Seed for the scale-soak scenario generator."),
     EnvFlag("KUEUE_TPU_TRAFFIC_SEED", "1109", "int",
             "Seed for the open-loop traffic soak."),
+    EnvFlag("KUEUE_TPU_FED_SEED", "1511", "int",
+            "Seed for the federation soak."),
+    EnvFlag("KUEUE_TPU_REMOTE_RETRIES", "2", "int",
+            "Per-request retry budget for HttpWorkerClient."),
+    EnvFlag("KUEUE_TPU_REMOTE_DEADLINE_S", "15", "int",
+            "Total per-request deadline (attempts + backoff sleeps) "
+            "for HttpWorkerClient, seconds."),
 )}
 
 

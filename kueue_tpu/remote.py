@@ -40,35 +40,47 @@ class ConnectionLost(Exception):
 class LocalWorkerClient:
     """In-process worker (a Driver in the same process).
 
-    ``ok`` is the fault-injection switch for tests: False makes health
-    probes fail so a mark_lost cluster stays lost (the multi-envtest
-    pattern's killed watch)."""
+    ``ok`` is the fault-injection switch for tests and the federation
+    sim: False severs the cluster — health probes fail and every API
+    call raises ConnectionLost (a partitioned worker is unreachable for
+    mutations too, not just watches — the multi-envtest pattern's
+    killed transport)."""
 
     def __init__(self, driver):
         self.driver = driver
         self.ok = True
 
+    def _check(self, what: str) -> None:
+        if not self.ok:
+            raise ConnectionLost(f"{what}: worker unreachable")
+
     def healthy(self) -> bool:
         return self.ok
 
     def create_workload(self, wl: Workload) -> None:
+        self._check("create")
         if wl.key not in self.driver.workloads:
             self.driver.create_workload(wl)
 
     def get_workload(self, key: str) -> Optional[Workload]:
+        self._check("get")
         return self.driver.workloads.get(key)
 
     def delete_workload(self, key: str) -> None:
+        self._check("delete")
         self.driver.delete_workload(key)
 
     def list_workload_keys(self) -> list[str]:
+        self._check("list")
         return list(self.driver.workloads)
 
     def list_workloads(self) -> dict[str, bool]:
+        self._check("list")
         return {k: wl.is_finished
                 for k, wl in list(self.driver.workloads.items())}
 
     def finish_workload(self, key: str, message: str = "finished") -> None:
+        self._check("finish")
         self.driver.finish_workload(key, message)
 
     def watch_events(self, since: int, timeout: float = 0.0):
@@ -187,7 +199,12 @@ class WatchLoop:
     connection loss pushes a ``("__lost__", ...)`` marker, then the loop
     keeps retrying with exponential backoff and pushes
     ``("__reconnected__", ...)`` when the stream is back — resuming from
-    the last seen token, so every missed event is replayed."""
+    the last seen token, so every missed event is replayed.
+
+    ``pump()`` is one poll-and-push step: the watch thread calls it in
+    a loop, and deterministic harnesses (the federation sim, the
+    delivery-order tests) call it directly with no thread in play so
+    event delivery happens at controlled points."""
 
     def __init__(self, client, poll_timeout: float = 10.0):
         import queue as _queue
@@ -199,6 +216,7 @@ class WatchLoop:
         self._stop = threading.Event()
         self._thread: Optional[threading.Thread] = None
         self._was_lost = False
+        self._backoff = 0.2
 
     def start(self) -> None:
         self._thread = threading.Thread(target=self._run, daemon=True)
@@ -210,41 +228,59 @@ class WatchLoop:
             self._thread.join(timeout=5.0)
 
     def _run(self) -> None:
-        backoff = 0.2
         while not self._stop.is_set():
-            try:
-                batch, nxt, epoch = self._poll()
-            except Exception as e:
-                # ANY failure is a connection loss (a dead watch thread
-                # would silently stop all sync for the cluster)
-                if not self._was_lost:
-                    self._was_lost = True
-                    self.events.put(("__lost__", "", str(e)))
-                self._stop.wait(backoff)
-                backoff = min(backoff * 2.0, 30.0)
-                continue
-            if (epoch is not None and self._epoch is not None
-                    and epoch != self._epoch):
-                # the worker restarted with a fresh event log: the resume
-                # token is meaningless — replay from 0 and tell the
-                # controller to resync this cluster's assignments
-                self._epoch = epoch
-                self.since = 0
-                self.events.put(("__resync__", "", ""))
-                continue
-            if epoch is not None:
-                self._epoch = epoch
-            if self._was_lost:
-                self._was_lost = False
-                self.events.put(("__reconnected__", "", ""))
-            backoff = 0.2
+            self.pump(wait=self._stop.wait)
+
+    def pump(self, wait=None) -> int:
+        """One poll-and-push iteration; returns the number of workload
+        events pushed.  ``wait`` is the pacing/backoff sleep — the watch
+        thread passes its stop-aware wait, direct callers leave it None
+        (no sleeping, the harness owns time)."""
+        if wait is None:
+            wait = lambda _s: None
+        try:
+            batch, nxt, epoch = self._poll()
+        except Exception as e:
+            # ANY failure is a connection loss (a dead watch thread
+            # would silently stop all sync for the cluster)
+            if not self._was_lost:
+                self._was_lost = True
+                self.events.put(("__lost__", "", str(e)))
+            wait(self._backoff)
+            self._backoff = min(self._backoff * 2.0, 30.0)
+            return 0
+        if (epoch is not None and self._epoch is not None
+                and epoch != self._epoch):
+            # the worker restarted with a fresh event log: the resume
+            # token is meaningless — replay from 0 and tell the
+            # controller to resync this cluster's assignments
+            self._epoch = epoch
+            self.since = 0
+            self.events.put(("__resync__", "", ""))
+            return 0
+        if epoch is not None:
+            self._epoch = epoch
+        if self._was_lost:
+            self._was_lost = False
+            self.events.put(("__reconnected__", "", ""))
+        self._backoff = 0.2
+        inj = _chaos.ACTIVE
+        if (inj is not None and batch
+                and inj.hit("remote.duplicate_event") is not None):
+            # at-least-once delivery: push the batch but do NOT advance
+            # the resume token, so the next poll re-delivers all of it
+            # (plus anything newer) — the controller's sync must absorb
+            # the replay
+            pass
+        else:
             self.since = nxt
-            for ev in batch:
-                self.events.put(tuple(ev))
-            if not batch:
-                # blocking clients already waited out the long poll; the
-                # in-process client returns instantly — pace either way
-                self._stop.wait(0.05)
+        for ev in batch:
+            self.events.put(tuple(ev))
+        if not batch:
+            # blocking clients already waited out the long poll; the
+            # in-process client returns instantly — pace either way
+            wait(0.05)
+        return len(batch)
 
     def _poll(self):
         out = self.client.watch_events(self.since,
@@ -258,18 +294,74 @@ class WatchLoop:
 class HttpWorkerClient:
     """Manager-side remote client (multikueuecluster.go remoteClient).
 
-    Any connection error raises ConnectionLost; the MultiKueue
-    controller marks the cluster inactive and retries with exponential
-    backoff (multikueuecluster.go:67 retryAfter)."""
+    Transient transport failures are retried in place with jittered
+    exponential backoff under a total-deadline budget: each request
+    gets up to ``retries`` re-attempts, the i-th backoff is
+    ``backoff_base·2^i`` stretched by a deterministic per-(path,
+    attempt) jitter (0.5×–1.5×, crc32 not random so retry storms
+    replay identically under test), and the whole request — attempts
+    plus sleeps — must fit inside ``deadline_s``.  Retrying mutations
+    is safe because the worker API is idempotent: create is keyed,
+    delete/finish are no-ops when already applied.  Only once the
+    budget is spent does ConnectionLost surface; the MultiKueue
+    controller then marks the cluster inactive and retries with its
+    own exponential backoff (multikueuecluster.go:67 retryAfter).
+    Watch polls are never retried here — the WatchLoop owns watch
+    backoff and must see the raw failure.
 
-    def __init__(self, base_url: str, timeout: float = 5.0):
+    ``KUEUE_TPU_REMOTE_RETRIES`` / ``KUEUE_TPU_REMOTE_DEADLINE_S``
+    override the defaults (see ``features.ENV_FLAGS``)."""
+
+    def __init__(self, base_url: str, timeout: float = 5.0,
+                 retries: Optional[int] = None,
+                 backoff_base: float = 0.05, backoff_max: float = 1.0,
+                 deadline_s: Optional[float] = None):
+        from .features import env_int
         self.base_url = base_url.rstrip("/")
         self.timeout = timeout
+        self.retries = (env_int("KUEUE_TPU_REMOTE_RETRIES")
+                        if retries is None else retries)
+        self.backoff_base = backoff_base
+        self.backoff_max = backoff_max
+        self.deadline_s = (float(env_int("KUEUE_TPU_REMOTE_DEADLINE_S"))
+                           if deadline_s is None else deadline_s)
+        self.stats = {"requests": 0, "retries": 0, "deadline_exhausted": 0}
+
+    @staticmethod
+    def _jitter(path: str, attempt: int) -> float:
+        import zlib
+        return (zlib.crc32(f"{path}#{attempt}".encode()) % 1000) / 1000.0
 
     def _request(self, method: str, path: str, body: Optional[dict] = None,
-                 timeout_override: Optional[float] = None):
+                 timeout_override: Optional[float] = None,
+                 retries: Optional[int] = None):
+        import time as _time
+        budget = self.retries if retries is None else retries
+        deadline = _time.monotonic() + self.deadline_s
+        attempt = 0
+        while True:
+            try:
+                return self._request_once(method, path, body,
+                                          timeout_override)
+            except ConnectionLost:
+                if attempt >= budget:
+                    raise
+                backoff = min(self.backoff_base * (2 ** attempt),
+                              self.backoff_max)
+                backoff *= 0.5 + self._jitter(path, attempt)
+                if _time.monotonic() + backoff >= deadline:
+                    self.stats["deadline_exhausted"] += 1
+                    raise
+                self.stats["retries"] += 1
+                _time.sleep(backoff)
+                attempt += 1
+
+    def _request_once(self, method: str, path: str,
+                      body: Optional[dict] = None,
+                      timeout_override: Optional[float] = None):
         import urllib.error
         import urllib.request
+        self.stats["requests"] += 1
         data = None if body is None else json.dumps(body).encode()
         req = urllib.request.Request(
             self.base_url + path, data=data, method=method,
@@ -298,8 +390,10 @@ class HttpWorkerClient:
             raise
 
     def healthy(self) -> bool:
+        # no retries: this is the half-open probe — the controller's
+        # reconnect backoff owns the retry cadence
         try:
-            return self._request("GET", "/healthz") is not None
+            return self._request("GET", "/healthz", retries=0) is not None
         except ConnectionLost:
             return False
 
@@ -340,7 +434,7 @@ class HttpWorkerClient:
         until events exist or the poll times out."""
         out = self._request(
             "GET", f"/apis/watch?since={since}&timeout={timeout}",
-            timeout_override=timeout + self.timeout)
+            timeout_override=timeout + self.timeout, retries=0)
         if out is None:
             return [], since, None
         return ([tuple(e) for e in out.get("events", [])],

@@ -289,6 +289,27 @@ def _jitter_fraction(key: str, count: int) -> float:
     return zlib.crc32(f"{key}/{count}".encode()) / 0xFFFFFFFF
 
 
+def next_requeue_state(wl: Workload, backoff_base_seconds: int,
+                       backoff_max_seconds: int, now: float,
+                       jitter: float = 0.0) -> tuple[int, float]:
+    """The ``(count, requeue_at)`` that ``update_requeue_state`` would
+    apply, computed without mutating the workload — so the WAL can
+    journal the decision before the store write (the journal-append-
+    dominates-mutation discipline that ``analysis/wal_order.py``
+    enforces over the driver)."""
+    count = (0 if wl.requeue_state is None else wl.requeue_state.count) + 1
+    if backoff_base_seconds <= 0:
+        wait_s = 0
+    elif count - 1 >= (backoff_max_seconds // backoff_base_seconds).bit_length():
+        wait_s = backoff_max_seconds
+    else:
+        wait_s = min(backoff_base_seconds * (2 ** (count - 1)),
+                     backoff_max_seconds)
+    if jitter:
+        wait_s += wait_s * jitter * _jitter_fraction(wl.key, count)
+    return count, now + wait_s
+
+
 def update_requeue_state(wl: Workload, backoff_base_seconds: int,
                          backoff_max_seconds: int, now: float,
                          jitter: float = 0.0) -> None:
@@ -301,19 +322,11 @@ def update_requeue_state(wl: Workload, backoff_base_seconds: int,
     each deadline by a per-workload fraction of up to that much, so a
     cohort evicted en masse fans back in instead of requeuing in
     lockstep — deterministic, so parity arms agree."""
+    count, requeue_at = next_requeue_state(
+        wl, backoff_base_seconds, backoff_max_seconds, now, jitter)
     if wl.requeue_state is None:
         wl.requeue_state = RequeueState()
-    count = wl.requeue_state.count + 1
-    if backoff_base_seconds <= 0:
-        wait_s = 0
-    elif count - 1 >= (backoff_max_seconds // backoff_base_seconds).bit_length():
-        wait_s = backoff_max_seconds
-    else:
-        wait_s = min(backoff_base_seconds * (2 ** (count - 1)),
-                     backoff_max_seconds)
-    if jitter:
-        wait_s += wait_s * jitter * _jitter_fraction(wl.key, count)
-    wl.requeue_state.requeue_at = now + wait_s
+    wl.requeue_state.requeue_at = requeue_at
     wl.requeue_state.count = count
 
 

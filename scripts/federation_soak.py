@@ -1,0 +1,358 @@
+"""Federation soak: N-cluster MultiKueue under fire at 1000 CQs.
+
+Every scenario runs two arms of the seeded federation sim
+(``kueue_tpu.federation``) from identical specs and identical traffic:
+
+  control — fault-free;
+  faulted — the same federation with a seeded ChaosInjector armed.
+
+A scenario passes only if the faulted arm converges back to the
+control arm after the fault clears (``decisions_stable``):
+
+  strict parity   — the post-recovery global state (manager + every
+                    worker, conditions and timestamps included) is
+                    bit-identical to the control arm's
+                    (partition/rejoin, duplicate storms, worker crash);
+  outcome parity  — the same workloads finish with zero invariant
+                    violations (permanent cluster loss: the ejection
+                    timing is the fault, so timestamps shift by
+                    design, but nothing may be lost or run twice).
+
+Both arms also carry the sim's per-step invariant sampling: no key is
+ever quota-reserved on two ACTIVE clusters (double admission) and no
+key ever finishes on two workers (double execution).
+
+Scenarios: a partition severing two clusters between nomination and
+winner selection (rejoined through the half-open circuit + rejoin
+reconciliation), an at-least-once watch storm (resume tokens held
+back, mutations doubled), a worker killed between its WAL append and
+the admit mutation (recovered from the journal the same virtual
+second), and a cluster destroyed outright (assignments ejected and
+re-dispatched).
+
+Usage:
+    python scripts/federation_soak.py [--cqs 1000] [--workers 4]
+        [--seed N] [--quick] [--only a,b] [--out FED_r15.json]
+
+The base seed comes from --seed or KUEUE_TPU_FED_SEED (default 1511);
+scenario i uses seed+i, so any single scenario replays in isolation.
+"""
+
+from __future__ import annotations
+
+import argparse
+import gc
+import json
+import os
+import sys
+import tempfile
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from kueue_tpu.chaos import injector as chaos
+from kueue_tpu.chaos.injector import ChaosInjector
+from kueue_tpu.features import env_value
+from kueue_tpu.federation.sim import (
+    FederationSim,
+    FedSpec,
+    global_digest,
+    outcome,
+    schedule_traffic,
+)
+from kueue_tpu.perf.harness import chaos_report
+from kueue_tpu.traffic.arrivals import (
+    ArrivalStream,
+    PoissonProcess,
+    TrafficSpec,
+)
+
+
+def log(msg: str) -> None:
+    print(msg, file=sys.stderr, flush=True)
+
+
+def make_traffic(cfg, seed):
+    """The shared mixed local/remote stream, quantized onto sim steps
+    (both arms of every scenario ingest the identical schedule)."""
+    spec = TrafficSpec(n_cqs=cfg["cqs"], remote_fraction=0.5,
+                       cancel_fraction=0.0, churn_fraction=0.0,
+                       runtime_choices_s=(2.0,))
+    events = ArrivalStream(PoissonProcess(cfg["rate"], seed=seed),
+                           spec, seed=seed).take(cfg["events"])
+    return schedule_traffic(events, n_cqs=cfg["cqs"],
+                            remote_cqs=cfg["remote_cqs"])
+
+
+def run_arm(cfg, seed, wal_dir, arm=None, **spec_kw):
+    """One sim arm.  Chaos is armed only after construction + traffic
+    load, so site hit counts line up with ``step()``'s consult points
+    regardless of build-time work."""
+    chaos.clear()
+    spec = FedSpec(n_workers=cfg["workers"], n_cqs=cfg["cqs"],
+                   remote_cqs=cfg["remote_cqs"], seed=seed, **spec_kw)
+    sim = FederationSim(spec, wal_dir=wal_dir)
+    by_step, n_remote = make_traffic(cfg, seed)
+    sim.load_traffic(by_step)
+    inj = None
+    if arm is not None:
+        inj = chaos.install(ChaosInjector(seed=seed))
+        arm(inj)
+    settled = sim.run(cfg["steps"], drain_max=cfg["drain_max"])
+    chaos.clear()
+    return sim, settled, inj, n_remote
+
+
+class Checker:
+    def __init__(self):
+        self.failures: list[str] = []
+
+    def check(self, ok, msg):
+        if not ok:
+            self.failures.append(msg)
+        return ok
+
+
+def _parity(ck, control, faulted, mode):
+    """The convergence verdict both parity levels share."""
+    ck.check(faulted.violations == [],
+             f"invariant violations: {faulted.violations[:2]}")
+    ck.check(control.violations == [], "control arm violated invariants")
+    if mode == "strict":
+        ck.check(global_digest(faulted) == global_digest(control),
+                 "post-recovery global state diverged from the "
+                 "fault-free control")
+    else:
+        ck.check(outcome(faulted) == outcome(control),
+                 "finish set diverged from the fault-free control")
+        ck.check(all(outcome(faulted).values()),
+                 "workloads left unfinished after failover")
+
+
+def _result(ck, control, faulted, inj, mode, extra=None):
+    out = {
+        "decisions_stable": not ck.failures,
+        "failures": ck.failures,
+        "parity": mode,
+        "double_admissions": sum(
+            1 for v in faulted.violations
+            if v.get("kind") == "double_admission"),
+        "ingested": faulted.ingested,
+        "finished": sum(1 for v in outcome(faulted).values() if v),
+        "spread": faulted.assignment_spread(),
+        "counters": dict(faulted.counters),
+        "state_digest": {"control": global_digest(control),
+                         "faulted": global_digest(faulted)},
+        "chaos": chaos_report(injector=inj),
+    }
+    out.update(extra or {})
+    return out
+
+
+def scenario_partition_during_nominate(cfg, seed, td):
+    """Sever two clusters between nomination/admission and winner
+    selection (the mid-step consult), heal after 3 steps: the rejoin
+    reconciliation must delete exactly the stale mirrors the control
+    deleted at winner time, bit-identically."""
+    control, ok_c, _i, _r = run_arm(cfg, seed, os.path.join(td, "c"))
+    victims = tuple(control.worker_names[-2:])
+    at_step = max(2, cfg["steps"] // 3)
+    faulted, ok_f, inj, _r = run_arm(
+        cfg, seed, os.path.join(td, "f"),
+        arm=lambda i: i.arm("fed.partition", at=2 * at_step,
+                            action="partition",
+                            payload=(victims, 3)))
+    ck = Checker()
+    ck.check(ok_c and ok_f, f"arm did not settle "
+             f"(control={ok_c}, faulted={ok_f})")
+    ck.check(faulted.counters["partitions"] >= 1, "partition never fired")
+    ck.check(faulted.counters["heals"] >= 1, "partition never healed")
+    ck.check(all(c.active for c in faulted.clusters.values()),
+             "a cluster never rejoined")
+    _parity(ck, control, faulted, "strict")
+    return _result(ck, control, faulted, inj, "strict",
+                   {"victims": list(victims), "partition_step": at_step})
+
+
+def scenario_duplicate_watch_storm(cfg, seed, td):
+    """At-least-once delivery storm: watch resume tokens held back so
+    whole batches re-deliver, plus doubled mutations on the transport.
+    Every replay must be absorbed — strict parity against a control
+    running the same (quiet) transport wrapper."""
+    control, ok_c, _i, _r = run_arm(cfg, seed, os.path.join(td, "c"),
+                                    chaos_transport=True, drift_every=4)
+    faulted, ok_f, inj, _r = run_arm(
+        cfg, seed, os.path.join(td, "f"),
+        chaos_transport=True, drift_every=4,
+        arm=lambda i: (
+            i.arm("remote.duplicate_event", prob=0.25,
+                  times=cfg["storm_times"], action="duplicate"),
+            i.arm("remote.duplicate", prob=0.05,
+                  times=cfg["storm_times"], action="duplicate")))
+    ck = Checker()
+    ck.check(ok_c and ok_f, f"arm did not settle "
+             f"(control={ok_c}, faulted={ok_f})")
+    _parity(ck, control, faulted, "strict")
+    return _result(ck, control, faulted, inj, "strict",
+                   {"storm_times": cfg["storm_times"]})
+
+
+def scenario_worker_crash_mid_sync(cfg, seed, td):
+    """Kill a worker between its WAL append and the admit mutation,
+    rebuild it from store + journal tail the same virtual second, and
+    re-run the interrupted cycle: the watch epoch change forces a
+    resync and the recovered federation must match control exactly."""
+    control, ok_c, _i, _r = run_arm(cfg, seed, os.path.join(td, "c"))
+    at_step = max(2, cfg["steps"] // 3)
+    faulted, ok_f, inj, _r = run_arm(
+        cfg, seed, os.path.join(td, "f"),
+        arm=lambda i: i.arm("fed.worker_crash", at=at_step,
+                            payload=control.worker_names[0]))
+    ck = Checker()
+    ck.check(ok_c and ok_f, f"arm did not settle "
+             f"(control={ok_c}, faulted={ok_f})")
+    ck.check(faulted.counters["worker_crashes"] == 1,
+             "worker crash never fired")
+    ck.check(faulted.counters["mid_admit_crashes"] >= 1,
+             "the crash missed the journaled-but-unapplied window")
+    ck.check(faulted.counters["wal_tail_replayed"] >= 1,
+             "recovery never replayed the WAL tail")
+    _parity(ck, control, faulted, "strict")
+    return _result(ck, control, faulted, inj, "strict",
+                   {"crash_step": at_step})
+
+
+def scenario_cluster_loss_permanent(cfg, seed, td):
+    """Destroy a cluster outright: everything it held must be ejected
+    (pending deletes queued, checks back to Retry) and re-dispatched to
+    the survivors exactly once.  Outcome parity: the ejection timing is
+    the fault, so timestamps shift, but the same workloads finish and
+    nothing runs twice."""
+    control, ok_c, _i, _r = run_arm(cfg, seed, os.path.join(td, "c"),
+                                    worker_lost_timeout=2.0)
+    at_step = max(2, cfg["steps"] // 3)
+    faulted, ok_f, inj, _r = run_arm(
+        cfg, seed, os.path.join(td, "f"), worker_lost_timeout=2.0,
+        arm=lambda i: i.arm("fed.cluster_loss", at=at_step,
+                            payload=control.worker_names[0]))
+    ck = Checker()
+    ck.check(ok_c and ok_f, f"arm did not settle "
+             f"(control={ok_c}, faulted={ok_f})")
+    ck.check(faulted.counters["losses"] == 1, "cluster loss never fired")
+    ck.check(faulted.counters["ejections"] >= 1,
+             "nothing was ejected off the dead cluster")
+    lost = control.worker_names[0]
+    ck.check(not faulted.clusters[lost].active,
+             "the destroyed cluster came back")
+    ck.check(all(len(ws) == 1 for ws in faulted._finished_on.values()),
+             "a workload executed on two workers")
+    _parity(ck, control, faulted, "outcome")
+    return _result(ck, control, faulted, inj, "outcome",
+                   {"lost_cluster": lost, "loss_step": at_step})
+
+
+SCENARIOS = [
+    ("partition_during_nominate", scenario_partition_during_nominate),
+    ("duplicate_watch_storm", scenario_duplicate_watch_storm),
+    ("worker_crash_mid_sync", scenario_worker_crash_mid_sync),
+    ("cluster_loss_permanent", scenario_cluster_loss_permanent),
+]
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser(description=__doc__)
+    ap.add_argument("--cqs", type=int, default=1000)
+    ap.add_argument("--workers", type=int, default=4)
+    ap.add_argument("--seed", type=int,
+                    default=int(env_value("KUEUE_TPU_FED_SEED", "1511")))
+    ap.add_argument("--quick", action="store_true",
+                    help="tiny federation for a fast functional pass")
+    ap.add_argument("--only", default=None,
+                    help="comma-separated scenario names")
+    ap.add_argument("--out", default=os.path.join(
+        os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+        "FED_r15.json"))
+    args = ap.parse_args()
+    if args.workers < 2:
+        ap.error("--workers must be >= 2 (failover needs a survivor)")
+
+    cqs = 16 if args.quick else args.cqs
+    cfg = {
+        "cqs": cqs,
+        "remote_cqs": max(2, cqs // 4),
+        "workers": args.workers,
+        "events": 5 * cqs,
+        "rate": max(4.0, cqs / 2.0),   # ~10 virtual seconds of arrivals
+        "steps": 12,
+        "drain_max": 400,
+        "storm_times": 30 * args.workers if cqs <= 16 else 400,
+    }
+    only = set(args.only.split(",")) if args.only else None
+
+    gc.collect()
+    scenarios: dict[str, dict] = {}
+    t_start = time.perf_counter()
+    with tempfile.TemporaryDirectory(prefix="federation_soak_") as td:
+        for i, (name, fn) in enumerate(SCENARIOS):
+            if only and name not in only:
+                continue
+            chaos.clear()
+            log(f"[{i + 1}/{len(SCENARIOS)}] {name} "
+                f"(cqs={cqs}, workers={cfg['workers']}, "
+                f"seed={args.seed + i}) ...")
+            t0 = time.perf_counter()
+            try:
+                res = fn(cfg, args.seed + i, os.path.join(td, name))
+            except Exception as e:   # a scenario bug is a failed scenario
+                res = {"decisions_stable": False, "parity": "strict",
+                       "double_admissions": 0,
+                       "failures": [f"{type(e).__name__}: {e}"]}
+            finally:
+                chaos.clear()
+            res["wall_s"] = round(time.perf_counter() - t0, 2)
+            res["seed"] = args.seed + i
+            scenarios[name] = res
+            ok = res["decisions_stable"]
+            log(f"    {'converged' if ok else 'DIVERGED'} "
+                f"({res['wall_s']}s)"
+                + ("" if ok else f" — {res['failures'][:3]}"))
+            gc.collect()
+
+    stable = sum(1 for v in scenarios.values() if v["decisions_stable"])
+    tail = {
+        "metric": "federation_soak_recovery_parity",
+        "unit": "fault arms converged to the fault-free control",
+        "cqs": cqs,
+        "remote_cqs": cfg["remote_cqs"],
+        "workers": cfg["workers"],
+        "events": cfg["events"],
+        "seed": args.seed,
+        "scenarios": scenarios,
+        "scenarios_total": len(scenarios),
+        "scenarios_stable": stable,
+        "all_stable": stable == len(scenarios) and len(scenarios) > 0,
+        "double_admissions_total": sum(
+            v.get("double_admissions", 0) for v in scenarios.values()),
+        "value": stable,
+        "elapsed_s": round(time.perf_counter() - t_start, 2),
+        "hard_paths_exercised": [
+            "fed.partition between nomination and winner selection",
+            "half-open try_reconnect + reconcile_rejoined stale-mirror GC",
+            "remote.duplicate_event resume-token holdback",
+            "remote.duplicate doubled mutations",
+            "fed.worker_crash wal.admit tail replay + watch epoch resync",
+            "fed.cluster_loss ejection + exactly-once re-dispatch",
+        ],
+    }
+    print(json.dumps({k: tail[k] for k in
+                      ("metric", "cqs", "workers", "scenarios_total",
+                       "scenarios_stable", "all_stable")}))
+    with open(args.out, "w") as f:
+        json.dump(tail, f, indent=1)
+        f.write("\n")
+    log(f"wrote {args.out}")
+    return 0 if tail["all_stable"] else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

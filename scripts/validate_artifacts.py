@@ -416,11 +416,63 @@ def _check_lint(d, path, out):
              "budget")
 
 
+def _check_fed(d, path, out):
+    """FED_* federation-soak artifacts (scripts/federation_soak.py):
+    a real federation (>= 4 worker clusters), every fault scenario
+    carrying a parity verdict against its fault-free control, zero
+    double-admissions anywhere, and strict scenarios proving
+    bit-identical post-recovery state via matching digests.  The
+    generic scenario-table consistency (all_stable vs the per-scenario
+    verdicts) is _check_chaos's job — the 'scenarios' key routes every
+    FED artifact through it as well."""
+    workers = d.get("workers")
+    if not isinstance(workers, int) or workers < 4:
+        _err(out, path, f"'workers'={workers}: the federation soak "
+             "needs >= 4 worker clusters")
+    scenarios = d.get("scenarios")
+    if not isinstance(scenarios, dict) or len(scenarios) < 4:
+        _err(out, path, "needs >= 4 fault scenarios")
+        scenarios = {}
+    dbl_total = 0
+    for name, s in scenarios.items():
+        if not isinstance(s, dict):
+            continue
+        parity = s.get("parity")
+        if parity not in ("strict", "outcome"):
+            _err(out, path, f"scenario '{name}': 'parity' must be "
+                 f"'strict' or 'outcome' (got {parity!r})")
+        dbl = s.get("double_admissions")
+        if not isinstance(dbl, int):
+            _err(out, path, f"scenario '{name}' missing int "
+                 "'double_admissions'")
+        else:
+            dbl_total += dbl
+            if dbl != 0:
+                _err(out, path, f"scenario '{name}': "
+                     f"{dbl} double-admissions")
+        digest = s.get("state_digest")
+        if not isinstance(digest, dict) \
+                or not isinstance(digest.get("control"), str) \
+                or not isinstance(digest.get("faulted"), str):
+            _err(out, path, f"scenario '{name}' missing "
+                 "'state_digest' {control, faulted}")
+        elif (parity == "strict" and s.get("decisions_stable")
+                and digest["control"] != digest["faulted"]):
+            _err(out, path, f"scenario '{name}': claims strict parity "
+                 "but the control/faulted digests differ")
+    if d.get("double_admissions_total") != dbl_total:
+        _err(out, path, "'double_admissions_total'="
+             f"{d.get('double_admissions_total')} but scenarios sum "
+             f"to {dbl_total}")
+    if not isinstance(d.get("elapsed_s"), (int, float)):
+        _err(out, path, "missing numeric 'elapsed_s'")
+
+
 # generator scripts that postdate the schema convention (metric+value
 # at top level); older BENCH_/MULTICHIP_r01-05 wrappers predate it and
 # only get the common checks
 _STRICT_PREFIXES = ("NORTHSTAR_", "CHAOS_", "TRAFFIC_", "SCALE_",
-                    "LINT_")
+                    "LINT_", "FED_")
 
 
 def validate(path: str) -> list[str]:
@@ -449,6 +501,10 @@ def validate(path: str) -> list[str]:
     # record even if the file was renamed
     if base.startswith("LINT_") or "stale_baseline" in d:
         _check_lint(d, path, out)
+    # by name or by shape: a per-cluster parity table marks a
+    # federation-soak record even if the file was renamed
+    if base.startswith("FED_") or "double_admissions_total" in d:
+        _check_fed(d, path, out)
     m = re.match(r"MULTICHIP_R(\d+)", base)
     if base.startswith(_STRICT_PREFIXES) or (m and int(m.group(1)) >= 8):
         _check_metric_value(d, path, out)

@@ -1,0 +1,534 @@
+"""Federation robustness: the N-cluster MultiKueue sim under fire.
+
+Tier-1 slice of ``scripts/federation_soak.py`` (which runs the same
+scenarios at 1000 CQs): every fault arm must converge to the fault-free
+control — strict state parity for partition/duplicate/crash, outcome
+parity for permanent cluster loss — with zero double-admissions and
+zero double-executions.  Plus unit coverage for the satellites: the
+half-open reconnect circuit, ejection's pending-delete ledger, the
+rejoin reconciliation, assignment recovery from worker listings,
+HttpWorkerClient's jittered retry/deadline budget, delivery-order
+independence of the watch pipeline, and the ``wal.requeue`` journal
+ordering (append before mutation).
+"""
+
+from __future__ import annotations
+
+import random
+
+import pytest
+
+from kueue_tpu.admissionchecks.multikueue import (
+    MultiKueueController,
+    WorkerCluster,
+)
+from kueue_tpu.api.types import (
+    AdmissionCheck,
+    ClusterQueue,
+    FlavorQuotas,
+    LocalQueue,
+    MultiKueueConfig,
+    PodSet,
+    ResourceFlavor,
+    ResourceGroup,
+    ResourceQuota,
+    Workload,
+)
+from kueue_tpu.chaos import injector as chaos
+from kueue_tpu.chaos.injector import ChaosInjector, InjectedCrash
+from kueue_tpu.controller.driver import Driver, WaitForPodsReadyConfig
+from kueue_tpu.federation.sim import (
+    FederationSim,
+    FedSpec,
+    global_digest,
+    outcome,
+    schedule_traffic,
+)
+from kueue_tpu.remote import (
+    ConnectionLost,
+    HttpWorkerClient,
+    LocalWorkerClient,
+    WatchLoop,
+)
+from kueue_tpu.traffic.arrivals import (
+    ArrivalStream,
+    PoissonProcess,
+    TrafficSpec,
+)
+from kueue_tpu.utils.journal import CycleWAL
+
+from tests.conftest import FakeClock
+from test_burst import mk, simple_cluster
+from test_chaos_recovery import full_state
+
+
+@pytest.fixture(autouse=True)
+def _chaos_off():
+    chaos.clear()
+    yield
+    chaos.clear()
+
+
+# ---------------------------------------------------------------------------
+# Builders
+# ---------------------------------------------------------------------------
+
+def make_worker(clock, nominal=8000):
+    d = Driver(clock=clock)
+    d.apply_resource_flavor(ResourceFlavor(name="default"))
+    d.apply_cluster_queue(ClusterQueue(
+        name="cq", resource_groups=[ResourceGroup(
+            covered_resources=["cpu"], flavors=[
+                FlavorQuotas(name="default", resources={
+                    "cpu": ResourceQuota(nominal=nominal)})])]))
+    d.apply_local_queue(LocalQueue(name="lq", cluster_queue="cq"))
+    return d
+
+
+def make_manager(clock, nominal=8000):
+    d = Driver(clock=clock)
+    d.apply_resource_flavor(ResourceFlavor(name="default"))
+    d.apply_admission_check(AdmissionCheck(
+        name="mk", controller_name="kueue.x-k8s.io/multikueue"))
+    d.apply_cluster_queue(ClusterQueue(
+        name="cq", admission_checks=["mk"],
+        resource_groups=[ResourceGroup(
+            covered_resources=["cpu"], flavors=[
+                FlavorQuotas(name="default", resources={
+                    "cpu": ResourceQuota(nominal=nominal)})])]))
+    d.apply_local_queue(LocalQueue(name="lq", cluster_queue="cq"))
+    return d
+
+
+def wl(name, cpu=1000, prio=0, t=0.0):
+    return Workload(name=name, queue_name="lq", priority=prio,
+                    creation_time=t,
+                    pod_sets=[PodSet(name="main", count=1,
+                                     requests={"cpu": cpu})])
+
+
+def quick_traffic(n_cqs=8, remote_cqs=4, n=40, seed=7):
+    spec = TrafficSpec(n_cqs=n_cqs, remote_fraction=0.5,
+                       cancel_fraction=0.0, churn_fraction=0.0)
+    evs = ArrivalStream(PoissonProcess(6.0, seed=seed), spec,
+                        seed=seed).take(n)
+    by_step, _ = schedule_traffic(evs, n_cqs=n_cqs, remote_cqs=remote_cqs)
+    return by_step
+
+
+def quick_sim(tmp_path, tag, arm=None, **kw):
+    """One sim arm at quick scale; chaos armed after traffic is loaded
+    so traffic generation never consumes hits."""
+    chaos.clear()
+    spec = FedSpec(n_workers=4, n_cqs=8, remote_cqs=4, seed=7, **kw)
+    sim = FederationSim(spec, wal_dir=str(tmp_path / tag))
+    sim.load_traffic(quick_traffic())
+    if arm is not None:
+        arm(chaos.install(ChaosInjector(seed=7)))
+    settled = sim.run(10, drain_max=120)
+    chaos.clear()
+    return sim, settled
+
+
+# ---------------------------------------------------------------------------
+# Sim parity: the four fault arms at quick scale
+# ---------------------------------------------------------------------------
+
+def test_fed_partition_rejoin_strict_parity(tmp_path):
+    """Partition two non-winner clusters mid-nomination, rejoin after 3
+    steps: post-recovery global state must be bit-identical to a
+    never-partitioned control (the rejoin reconciliation must delete
+    exactly the stale mirrors the control deleted at winner time)."""
+    ctl_sim, ok_c = quick_sim(tmp_path, "ctl")
+    fault, ok_f = quick_sim(
+        tmp_path, "part",
+        arm=lambda i: i.arm("fed.partition", at=6, action="partition",
+                            payload=(("w2", "w3"), 3)))
+    assert ok_c and ok_f
+    assert fault.violations == []
+    assert global_digest(fault) == global_digest(ctl_sim)
+    assert all(c.active for c in fault.clusters.values())
+
+
+def test_fed_duplicate_watch_storm_strict_parity(tmp_path):
+    """At-least-once delivery storm: resume tokens held back
+    (``remote.duplicate_event``) and doubled mutations
+    (``remote.duplicate``) — the sync must absorb every replay."""
+    ctl_sim, ok_c = quick_sim(tmp_path, "ctl", chaos_transport=True)
+    fault, ok_f = quick_sim(
+        tmp_path, "dup", chaos_transport=True,
+        arm=lambda i: (
+            i.arm("remote.duplicate_event", prob=0.25, times=60,
+                  action="duplicate"),
+            i.arm("remote.duplicate", prob=0.10, times=60,
+                  action="duplicate")))
+    assert ok_c and ok_f
+    assert fault.violations == []
+    assert global_digest(fault) == global_digest(ctl_sim)
+
+
+def test_fed_worker_crash_mid_sync_parity(tmp_path):
+    """Kill a worker between its WAL append and the admit mutation,
+    recover from the journal the same step: WAL replay + the watch
+    epoch resync must leave global state identical to control."""
+    ctl_sim, ok_c = quick_sim(tmp_path, "ctl")
+    fault, ok_f = quick_sim(
+        tmp_path, "crash",
+        arm=lambda i: i.arm("fed.worker_crash", at=3, payload="w0"))
+    assert ok_c and ok_f
+    assert fault.counters["mid_admit_crashes"] == 1
+    assert fault.counters["wal_tail_replayed"] >= 1
+    assert fault.violations == []
+    assert global_digest(fault) == global_digest(ctl_sim)
+
+
+def test_fed_cluster_loss_failover(tmp_path):
+    """Destroy a cluster permanently: every assignment it held must be
+    ejected and re-dispatched exactly once (no double-admission, no
+    double-execution) and every workload still finishes."""
+    ctl_sim, ok_c = quick_sim(tmp_path, "ctl", worker_lost_timeout=2.0)
+    fault, ok_f = quick_sim(
+        tmp_path, "loss", worker_lost_timeout=2.0,
+        arm=lambda i: i.arm("fed.cluster_loss", at=3, payload="w0"))
+    assert ok_c and ok_f
+    assert fault.counters["ejections"] > 0
+    assert fault.violations == []
+    assert not fault.clusters["w0"].active
+    # outcome parity: identical finish set despite losing a cluster
+    assert outcome(fault) == outcome(ctl_sim)
+    assert all(v for v in outcome(fault).values())
+    # the dead cluster executed nothing that also ran elsewhere
+    assert all(len(ws) == 1 for ws in fault._finished_on.values())
+
+
+# ---------------------------------------------------------------------------
+# Delivery-order independence (property-style, seeded shuffles)
+# ---------------------------------------------------------------------------
+
+def _run_shuffled_arm(seed):
+    """One full dispatch/finish flow where every watch batch is
+    delivered shuffled and partially duplicated by ``seed``.  w0 can
+    hold only 2 of the 6 workloads, so winner selection must spill the
+    rest to w1 regardless of delivery order."""
+    clock = FakeClock()
+    mgr = make_manager(clock)
+    workers = {"w0": make_worker(clock, nominal=2000),
+               "w1": make_worker(clock, nominal=8000)}
+    clusters = {n: WorkerCluster(name=n, driver=d)
+                for n, d in workers.items()}
+    ctl = MultiKueueController(
+        mgr, "mk", MultiKueueConfig(name="cfg", clusters=["w0", "w1"]),
+        clusters, worker_lost_timeout=60.0)
+    for c in clusters.values():
+        c.watch = WatchLoop(c.client, poll_timeout=0.0)
+
+    rng = random.Random(seed)
+
+    def pump_shuffled():
+        # deliver each cluster's pending events out of order, with a
+        # random subset re-delivered (at-least-once semantics)
+        for c in clusters.values():
+            w = c.watch
+            batch, nxt, epoch = c.client.watch_events(w.since, timeout=0.0)
+            w._epoch = epoch
+            w.since = nxt
+            batch = list(batch) + [e for e in batch if rng.random() < 0.5]
+            rng.shuffle(batch)
+            for ev in batch:
+                w.events.put(tuple(ev))
+
+    for i in range(6):
+        mgr.create_workload(wl(f"j{i}", prio=i % 3, t=float(i)))
+    mgr.run_until_settled()
+    clock.tick()
+    ctl.reconcile()                      # nominate mirrors everywhere
+    # workers admit one head per CQ per cycle: iterate rounds until
+    # every workload has a winner (w0 fills at 2, the rest spill to w1)
+    for _ in range(12):
+        if (len(ctl.assignments) == 6
+                and all(a.cluster for a in ctl.assignments.values())):
+            break
+        for d in workers.values():
+            d.schedule_once()
+        pump_shuffled()
+        clock.tick()
+        ctl.reconcile()                  # winner selection, loser deletes
+    # snapshot before finishes: _cleanup drops finished assignments
+    placed = {k: a.cluster for k, a in sorted(ctl.assignments.items())}
+    for name, d in workers.items():
+        for key in list(d.workloads):
+            asg = ctl.assignments.get(key)
+            if (asg is not None and asg.cluster == name
+                    and d.workloads[key].has_quota_reservation):
+                d.finish_workload(key)
+    pump_shuffled()
+    clock.tick()
+    ctl.reconcile()                      # copy-back of remote finishes
+    return (
+        placed,
+        {k: (w.admission_check_states["mk"].state, w.is_finished)
+         for k, w in sorted(mgr.workloads.items())},
+        {n: sorted(d.workloads) for n, d in workers.items()},
+    )
+
+
+def test_delivery_order_convergence_across_seeds():
+    """The watch pipeline must converge to one final state no matter
+    how events are ordered or duplicated: winner selection polls
+    clusters in config order, syncs are idempotent, and redelivered
+    events are absorbed.  10 seeded shuffles, one answer."""
+    results = [_run_shuffled_arm(seed) for seed in range(10)]
+    assignments, states, mirrors = results[0]
+    assert set(assignments.values()) == {"w0", "w1"}   # real spillover
+    assert all(s == ("Ready", True) for s in states.values())
+    for r in results[1:]:
+        assert r == results[0]
+
+
+def test_duplicate_event_token_holdback_is_idempotent():
+    """``remote.duplicate_event`` holds the resume token: the same
+    batch is pushed again on the next pump, and the queue consumer
+    must see every event at least once with no skips."""
+    clock = FakeClock()
+    d = make_worker(clock)
+    d.create_workload(wl("a"))
+    d.schedule_once()
+    w = WatchLoop(LocalWorkerClient(d), poll_timeout=0.0)
+    chaos.install(ChaosInjector(seed=3)).arm(
+        "remote.duplicate_event", at=1, action="duplicate")
+    n1 = w.pump()
+    assert n1 > 0 and w.since == 0       # delivered, token held back
+    chaos.clear()
+    n2 = w.pump()
+    assert n2 == n1 and w.since == n1    # full redelivery, then advance
+    seen = []
+    while not w.events.empty():
+        seen.append(w.events.get_nowait())
+    assert seen[:n1] == seen[n1:]        # byte-identical replay
+
+
+# ---------------------------------------------------------------------------
+# Ejection, rejoin, half-open circuit
+# ---------------------------------------------------------------------------
+
+def _two_cluster_ctl(clock, budget=0):
+    mgr = make_manager(clock)
+    workers = {"w0": make_worker(clock), "w1": make_worker(clock)}
+    clusters = {n: WorkerCluster(name=n, driver=d, reconnect_budget=budget)
+                for n, d in workers.items()}
+    ctl = MultiKueueController(
+        mgr, "mk", MultiKueueConfig(name="cfg", clusters=["w0", "w1"]),
+        clusters, worker_lost_timeout=3.0)
+    return mgr, workers, clusters, ctl
+
+
+def test_eject_queues_pending_deletes_and_redispatches():
+    """A worker lost past the timeout: its assignment resets to Retry,
+    the unreachable mirror lands in the pending-delete ledger, the
+    workload re-dispatches to the surviving cluster, and the rejoin
+    reconciliation later deletes the stale mirror before the circuit
+    closes."""
+    clock = FakeClock()
+    mgr, workers, clusters, ctl = _two_cluster_ctl(clock)
+    mgr.create_workload(wl("a"))
+    mgr.run_until_settled()
+    ctl.reconcile()
+    workers["w0"].schedule_once()
+    clock.tick()
+    ctl.reconcile()
+    assert ctl.assignments["default/a"].cluster == "w0"
+
+    clusters["w0"].client.ok = False     # sever the winner
+    clock.tick()
+    ctl.reconcile()                      # marks lost (GET fails)
+    clock.tick(5.0)                      # past worker_lost_timeout
+    # first pass ejects; quota frees only after the RETRY backoff,
+    # then the surviving worker must reserve for the re-dispatch to win
+    for _ in range(4):
+        ctl.reconcile()
+        mgr.queues.queue_inadmissible_workloads(["cq"])
+        mgr.run_until_settled()
+        workers["w1"].schedule_once()
+        ctl.reconcile()
+        clock.tick(2.0)
+    assert "default/a" in ctl.pending_deletes.get("w0", set())
+    assert ctl.assignments["default/a"].cluster == "w1"
+    assert "default/a" in workers["w1"].admitted_keys()
+
+    clusters["w0"].client.ok = True      # heal: probe → rejoin → flush
+    clock.tick(120.0)
+    ctl.reconcile()
+    assert clusters["w0"].active and not clusters["w0"].half_open
+    assert "w0" not in ctl.pending_deletes
+    assert "default/a" not in workers["w0"].workloads, \
+        "rejoin reconciliation must delete the stale mirror"
+
+
+def test_half_open_trial_failure_escalates_backoff():
+    """A passing probe opens only a trial window; a failure during the
+    trial escalates the existing backoff instead of resetting it, so a
+    flapping worker never gets a fresh budget per flap."""
+    c = WorkerCluster(name="w", driver=Driver())
+    c.mark_lost(100.0)
+    assert not c.active and c.retry_backoff == 1.0
+    assert not c.try_reconnect(100.5)     # before next_retry: no probe
+    assert c.try_reconnect(101.5)         # probe passes (client healthy)
+    assert c.half_open and not c.active   # trial open, circuit NOT closed
+    c.mark_lost(102.0)                    # trial failed
+    assert c.retry_backoff == 2.0 and not c.half_open
+    assert c.try_reconnect(105.0)
+    c.mark_lost(106.0)
+    assert c.retry_backoff == 4.0         # keeps doubling across flaps
+    assert c.try_reconnect(111.0)
+    c.reconnect()                         # trial succeeded: reset
+    assert c.active and c.retry_backoff == 1.0 and c.reconnect_attempts == 0
+
+
+def test_reconnect_budget_exhaustion_is_permanent():
+    """``reconnect_budget`` probes against a dead worker, then the
+    cluster is declared permanently failed and never probed again."""
+    d = Driver()
+    client = LocalWorkerClient(d)
+    client.ok = False
+    c = WorkerCluster(name="w", driver=d, client=client,
+                      reconnect_budget=2)
+    c.mark_lost(100.0)
+    assert not c.try_reconnect(102.0)     # probe 1 fails
+    assert not c.failed_permanently
+    assert not c.try_reconnect(110.0)     # probe 2 fails: budget spent
+    assert c.failed_permanently
+    client.ok = True
+    assert not c.try_reconnect(1000.0), \
+        "a permanently-failed cluster is never probed again"
+
+
+def test_recover_assignments_rebuilds_from_worker_listings():
+    """A restarted manager controller rebuilds its assignment table
+    from worker listings: a reserved remote is the winner, mirrors
+    without reservation are re-nominations, and extras are deleted."""
+    clock = FakeClock()
+    mgr, workers, clusters, ctl = _two_cluster_ctl(clock)
+    mgr.create_workload(wl("a"))
+    mgr.create_workload(wl("b", t=1.0))
+    mgr.run_until_settled()
+    ctl.reconcile()                      # nominate both on both
+    workers["w0"].schedule_once()        # w0 reserves both
+    clock.tick()
+    ctl.reconcile()                      # winner w0, losers deleted
+    before = {k: (a.cluster, tuple(a.nominated))
+              for k, a in ctl.assignments.items()}
+
+    ctl2 = MultiKueueController(
+        mgr, "mk", MultiKueueConfig(name="cfg", clusters=["w0", "w1"]),
+        clusters, worker_lost_timeout=3.0)
+    assert ctl2.assignments == {}
+    recovered = ctl2.recover_assignments()
+    assert recovered == 2
+    after = {k: (a.cluster, tuple(a.nominated))
+             for k, a in ctl2.assignments.items()}
+    assert after == before
+
+
+# ---------------------------------------------------------------------------
+# HttpWorkerClient retry budget
+# ---------------------------------------------------------------------------
+
+def _dead_port():
+    import socket
+    s = socket.socket()
+    s.bind(("127.0.0.1", 0))
+    port = s.getsockname()[1]
+    s.close()
+    return port
+
+
+def test_http_client_retries_then_surfaces_loss():
+    """Connection refused: the request retries through its budget with
+    jittered backoff, then surfaces ConnectionLost with the attempts
+    accounted in stats."""
+    c = HttpWorkerClient(f"http://127.0.0.1:{_dead_port()}",
+                         timeout=0.2, retries=2, backoff_base=0.001,
+                         backoff_max=0.004, deadline_s=30.0)
+    with pytest.raises(ConnectionLost):
+        c.list_workload_keys()
+    assert c.stats["requests"] == 3      # 1 attempt + 2 retries
+    assert c.stats["retries"] == 2
+    assert not c.healthy()               # half-open probe: no retries
+    assert c.stats["requests"] == 4
+
+
+def test_http_client_deadline_budget_caps_retries():
+    """A deadline smaller than the first backoff: the retry loop must
+    give up inside the budget rather than sleeping past it."""
+    c = HttpWorkerClient(f"http://127.0.0.1:{_dead_port()}",
+                         timeout=0.2, retries=50, backoff_base=0.5,
+                         backoff_max=1.0, deadline_s=0.2)
+    with pytest.raises(ConnectionLost):
+        c.list_workload_keys()
+    assert c.stats["deadline_exhausted"] == 1
+    assert c.stats["requests"] < 5       # nowhere near the retry cap
+
+
+def test_http_client_jitter_is_deterministic():
+    j = HttpWorkerClient._jitter
+    assert j("/apis/workloads", 1) == j("/apis/workloads", 1)
+    assert 0.0 <= j("/apis/workloads", 1) < 1.0
+    assert j("/apis/workloads", 1) != j("/apis/workloads", 2)
+
+
+def test_local_client_severed_raises_on_mutations():
+    d = make_worker(FakeClock())
+    client = LocalWorkerClient(d)
+    client.ok = False
+    for op in (lambda: client.create_workload(wl("x")),
+               lambda: client.get_workload("default/x"),
+               lambda: client.delete_workload("default/x"),
+               lambda: client.list_workloads(),
+               lambda: client.finish_workload("default/x", "m")):
+        with pytest.raises(ConnectionLost):
+            op()
+    assert not client.healthy()
+
+
+# ---------------------------------------------------------------------------
+# wal.requeue ordering: append before mutation
+# ---------------------------------------------------------------------------
+
+def test_wal_requeue_journal_precedes_mutation():
+    """Crash exactly at ``wal.requeue``: the requeue op is already in
+    the journal tail but the workload is untouched (append-before-
+    mutate), and recovery applies the journaled backoff exactly once."""
+    clock = FakeClock()
+    d1 = Driver(clock=clock, wait_for_pods_ready=WaitForPodsReadyConfig(
+        enable=True, timeout_seconds=30.0,
+        requeuing_backoff_base_seconds=10,
+        requeuing_backoff_max_seconds=100))
+    simple_cluster(n_cohorts=1, cqs=1)(d1)
+    d1.create_workload(mk("slow", "lq-0-0", 1000, t=1.0))
+    wal = CycleWAL()
+    d1.attach_wal(wal)
+    d1.run_until_settled()
+    clock.tick(31.0)
+    chaos.install(ChaosInjector(seed=5)).arm("wal.requeue", at=1)
+    with pytest.raises(InjectedCrash):
+        d1.evict_for_pods_ready_timeout("default/slow")
+    chaos.clear()
+
+    ops = [op for op in wal.tail if op["op"] == "requeue"]
+    assert len(ops) == 1, "requeue intent journaled before the crash"
+    assert d1.workloads["default/slow"].requeue_state is None, \
+        "crash lands between journal append and mutation"
+    assert not any(op["op"] == "evict" for op in wal.tail)
+
+    d2 = Driver(clock=clock, wait_for_pods_ready=WaitForPodsReadyConfig(
+        enable=True, timeout_seconds=30.0,
+        requeuing_backoff_base_seconds=10,
+        requeuing_backoff_max_seconds=100))
+    simple_cluster(n_cohorts=1, cqs=1)(d2)
+    assert d2.recover_from(d1.workloads.values(), wal) >= 1
+    rs = d2.workloads["default/slow"].requeue_state
+    assert rs is not None and rs.count == 1
+    assert rs.requeue_at == ops[0]["at"]
+    # the eviction itself never journaled, so the workload stays
+    # admitted: the next pods-ready sweep re-detects and re-evicts
+    assert "default/slow" in d2.admitted_keys()
