@@ -134,6 +134,9 @@ class MultiKueueController:
                  manager_jobs=None,
                  worker_jobs: dict[str, object] | None = None):
         self.manager = manager_driver
+        # back-reference for the debugger's federation-circuit section
+        # (debugger.dump_state reads driver.multikueue.clusters)
+        manager_driver.multikueue = self
         self.check_name = check_name
         self.config = config
         self.clusters = clusters
@@ -490,6 +493,8 @@ class MultiKueueController:
             if asg.cluster == cname or cname in asg.nominated:
                 self.pending_deletes.setdefault(cname, set()).add(key)
                 self._reset(key)
+                self.manager.obs.emit("eject", key, reason="WorkerLost",
+                                      note=cname)
 
     def recover_assignments(self) -> int:
         """Rebuild the assignment map after a manager restart

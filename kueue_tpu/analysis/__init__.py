@@ -16,7 +16,11 @@ of a review-time error):
   injection sites agree exactly (``analysis.chaos_sites``);
 - **env-flag registry** — every ``KUEUE_TPU_*`` read goes through the
   ``features.ENV_FLAGS`` table and appears in the README flag table
-  (``analysis.env_flags``).
+  (``analysis.env_flags``);
+- **metrics-doc registry** — every ``kueue_*`` series emitted into the
+  metrics registry is declared in ``metrics._SERIES_DEFS`` and
+  documented in the README metrics table, both directions
+  (``analysis.metrics_doc``).
 
 ``scripts/lint_invariants.py`` is the CLI; ``run_all`` is the API.
 Grandfathered findings live in ``baseline.json`` next to this file —

@@ -137,7 +137,8 @@ def collect_files(root: str, paths: Iterable[str]) -> list[ParsedFile]:
 
 
 def all_passes() -> list[Pass]:
-    from . import chaos_sites, dtypes, env_flags, purity, wal_order
+    from . import (chaos_sites, dtypes, env_flags, metrics_doc, purity,
+                   wal_order)
     return [
         Pass("purity", "no host effects reachable from jit/shard_map",
              purity.run),
@@ -149,6 +150,8 @@ def all_passes() -> list[Pass]:
              chaos_sites.run),
         Pass("env-flags", "KUEUE_TPU_* reads go through the registry",
              env_flags.run),
+        Pass("metrics-doc", "every emitted kueue_* series is documented",
+             metrics_doc.run),
     ]
 
 

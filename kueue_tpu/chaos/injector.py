@@ -52,6 +52,10 @@ site                            effect at the call point
                                 it from its journal within the same step
 ``fed.cluster_loss``            sever the payload worker cluster forever
                                 (drives the eject/re-dispatch path)
+``obs.dump``                    crash mid-flight-recorder dump: the ring
+                                snapshot is taken but serialization has not
+                                happened (a re-dump after recovery must be
+                                identical — dumping never mutates the ring)
 ==============================  =============================================
 
 ``KUEUE_TPU_CHAOS_SEED`` seeds the process-default injector (see

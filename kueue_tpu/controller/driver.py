@@ -36,6 +36,8 @@ from ..api.types import (
 )
 from ..cache.cache import Cache
 from ..chaos import injector as _chaos
+from ..obs import ObsPlane
+from ..obs.trace import span as _span
 from ..queue.manager import Manager as QueueManager
 from ..utils import journal as _journal
 from ..queue.cluster_queue import RequeueReason
@@ -134,6 +136,9 @@ class Driver:
         # WAL tail: they sit out the first post-recovery cycle so the
         # completed cycle matches the uncrashed one decision-for-decision
         self._resume_mask: set[str] = set()
+        # observability plane: event stream + flight recorder, always
+        # attached; span tracing opt-in via KUEUE_TPU_OBS_TRACE (obs/)
+        self.obs = ObsPlane.from_env(self)
 
     @staticmethod
     def _env_shards() -> int:
@@ -537,6 +542,7 @@ class Driver:
         if new_wl.is_admitted:
             self.metrics.admitted_workload(cq, now - new_wl.creation_time)
         self.events.append(("QuotaReserved", new_wl.key, cq))
+        self.obs.emit("admit", new_wl.key, cq, "QuotaReserved")
         return True
 
     def _apply_preemption(self, info: Info, reason: str, message: str) -> None:
@@ -547,6 +553,9 @@ class Driver:
             return
         self._evict(wl, EVICTED_BY_PREEMPTION, message, preempted_reason=reason)
         self.events.append(("Preempted", info.key, reason))
+        self.obs.emit("preempt", info.key,
+                      getattr(info, "cluster_queue", "") or "", reason,
+                      note=message)
 
     def _evict(self, wl: Workload, reason: str, message: str,
                preempted_reason: str | None = None) -> None:
@@ -579,10 +588,12 @@ class Driver:
                 self.metrics.release_admitted(cq_name)
             unset_quota_reservation(wl, reason, message, now)
         self.metrics.evicted(cq_name, reason)
+        self.obs.emit("evict", wl.key, cq_name, reason, note=message)
         # requeue: back into the pending queues
         set_requeued_condition(wl, reason, message, True, now)
         if wl.is_active:
             self.queues.add_or_update_workload(wl)
+            self.obs.emit("requeue", wl.key, cq_name, reason)
         if cq_name:
             self.queues.queue_inadmissible_workloads([cq_name])
         self.wake_gate_blocked()   # evicting a not-ready blocker opens the gate
@@ -608,6 +619,8 @@ class Driver:
                             borrowing_limit=quota.borrowing_limit,
                             lending_limit=quota.lending_limit)
         self.metrics.sample_pending(self.queues)
+        self.metrics.obs_sample(self.obs.events.report(),
+                                self.obs.flight.recorded_total)
         # LocalQueue mirrors (LocalQueueMetrics feature gate)
         from .. import features
         if features.enabled("LocalQueueMetrics"):
@@ -781,6 +794,7 @@ class Driver:
         self.metrics.admission_attempt(bool(stats.admitted), stats.duration_s)
         if self._wal is not None:
             self._wal.commit()
+        self.obs.record_cycle(stats)
         return stats
 
     def schedule_burst(self, max_cycles: int, runtime: int = 0,
@@ -881,6 +895,7 @@ class Driver:
             stats.finish_s = _time.perf_counter() - t0
             if self._wal is not None:
                 self._wal.commit()
+            self.obs.record_cycle(stats)
             if on_cycle is not None:
                 on_cycle(k, stats)
 
@@ -981,10 +996,11 @@ class Driver:
                 K = next((r for r in K_BURST_LADDER if r >= min(
                     remaining, K_BURST_LADDER[-1])), K_BURST_LADDER[-1])
                 _t_pack = time.perf_counter()
-                plan, self._burst_pack_state, _ = pack_burst_cached(
-                    st, self.queues, self.cache, self.scheduler,
-                    self.clock, state=self._burst_pack_state,
-                    min_m=self._burst_m, window=K, stats=bstats)
+                with _span("burst.pack"):
+                    plan, self._burst_pack_state, _ = pack_burst_cached(
+                        st, self.queues, self.cache, self.scheduler,
+                        self.clock, state=self._burst_pack_state,
+                        min_m=self._burst_m, window=K, stats=bstats)
                 bstats["burst_pack_s"] += time.perf_counter() - _t_pack
                 bstats["burst_packs"] += 1
                 if plan is None:
@@ -1016,8 +1032,9 @@ class Driver:
                     if not normal_cycle() and quiescent():
                         break
                     continue
-                handle = self._burst_solver.dispatch(
-                    plan, K, runtime, ext_release, ext_unpark)
+                with _span("burst.dispatch"):
+                    handle = self._burst_solver.dispatch(
+                        plan, K, runtime, ext_release, ext_unpark)
                 # a fresh pack re-read the live reservation timestamps;
                 # candidate ordering inside the kernel assumes they
                 # strictly increase across applied cycles (and past
@@ -1031,7 +1048,8 @@ class Driver:
             # decision planes are assembled — each shard's decision
             # transfer then overlaps the chained kernel and this
             # window's apply loop instead of serializing ahead of them
-            dirty, dirty_reason = self._burst_solver.fetch_flags(handle)
+            with _span("burst.fetch"):
+                dirty, dirty_reason = self._burst_solver.fetch_flags(handle)
             base = len(out)
             # two-slot pipeline: chain the NEXT window off this one's
             # final carry before applying, so its kernel computes while
@@ -1052,12 +1070,14 @@ class Driver:
                     and not bool(np.asarray(dirty).any())
                     and not any(off >= base + K for off in ext)):
                 F = max(1, len(st.fr_index))
-                spec = self._burst_solver.dispatch_next(
-                    handle,
-                    np.zeros((K, plan.C, F), dtype=np.int32),
-                    np.zeros((K, plan.G), dtype=bool))
-            (head_row, kind, slot, borrows, tgt_words, dirty,
-             dirty_reason) = self._burst_solver.fetch(handle)
+                with _span("burst.dispatch"):
+                    spec = self._burst_solver.dispatch_next(
+                        handle,
+                        np.zeros((K, plan.C, F), dtype=np.int32),
+                        np.zeros((K, plan.G), dtype=bool))
+            with _span("burst.fetch"):
+                (head_row, kind, slot, borrows, tgt_words, dirty,
+                 dirty_reason) = self._burst_solver.fetch(handle)
             from ..ops import burst as _b
             kind_name = {_b.KIND_ADMIT: "admit", _b.KIND_SKIP: "skip",
                          _b.KIND_PARK: "park", _b.KIND_PREEMPT: "preempt",
@@ -1144,7 +1164,8 @@ class Driver:
                     # empty cycle: pending finishes may unpark work
                     normal_cycle(heads=[], advance=False)
                     continue
-                stats = self.scheduler.apply_burst_cycle(heads, modeled)
+                with _span("burst.apply"):
+                    stats = self.scheduler.apply_burst_cycle(heads, modeled)
                 if stats is None:
                     # a modeled preempt target has no live admitted
                     # counterpart: the model and the real state diverged
@@ -1253,6 +1274,7 @@ class Driver:
         def on_cycle(stats):
             self.metrics.admission_attempt(bool(stats.admitted),
                                            stats.duration_s)
+            self.obs.record_cycle(stats)
 
         def on_tick():
             if self.wait_for_pods_ready.enable:
@@ -1345,6 +1367,7 @@ class Driver:
         self.metrics.burst_solver_sample(out.get("burst"),
                                          out.get("flavor_walk"))
         self.metrics.pack_sample(out.get("pack"), out.get("wal"))
+        out["obs"] = self.obs.report()
         return out
 
     def admitted_keys(self) -> set[str]:

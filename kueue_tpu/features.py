@@ -150,6 +150,12 @@ ENV_FLAGS: dict[str, EnvFlag] = {f.name: f for f in (
     EnvFlag("KUEUE_TPU_REMOTE_DEADLINE_S", "15", "int",
             "Total per-request deadline (attempts + backoff sleeps) "
             "for HttpWorkerClient, seconds."),
+    EnvFlag("KUEUE_TPU_OBS_TRACE", "0", "bool",
+            "Enable hot-path span tracing at driver construction."),
+    EnvFlag("KUEUE_TPU_OBS_EVENTS", "4096", "int",
+            "Event-stream ring capacity (admit/evict/preempt/...)."),
+    EnvFlag("KUEUE_TPU_FLIGHT_CYCLES", "256", "int",
+            "Flight-recorder ring capacity, in cycles."),
 )}
 
 

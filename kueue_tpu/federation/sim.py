@@ -452,9 +452,11 @@ class FederationSim:
         self._consult_partition()          # consult #1: step start
         self._heal_due()
         self._apply_drift()
+        from ..obs.trace import span as _span
         self._ingest()
         self.manager.schedule_once()
-        self.ctl.reconcile()               # nomination
+        with _span("fed.sync"):
+            self.ctl.reconcile()           # nomination
         crash_target = self._consult_worker_crash()
         for name in self.worker_names:
             if name in self._dead:
@@ -469,7 +471,8 @@ class FederationSim:
         self._drive_worker_finishes()
         self._pump_watches()
         self._consult_partition()          # consult #2: mid-step
-        self.ctl.reconcile()               # winner selection, copy-back
+        with _span("fed.sync"):
+            self.ctl.reconcile()           # winner selection, copy-back
         self._drive_local_finishes()
         self._check_invariants()
 

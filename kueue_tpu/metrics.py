@@ -209,18 +209,33 @@ class Registry:
 
     def burst_solver_sample(self, burst_stats=None, walk_stats=None) -> None:
         """Publish the burst solver's dirty/fallback counters and the
-        cycle solver's flavor-walk telemetry as ``kueue_burst_*`` gauges."""
+        cycle solver's flavor-walk telemetry as ``kueue_burst_*`` gauges.
+
+        Gauge names are spelled out literally (no ``"kueue_" + k``
+        construction) so the metrics-doc lint can statically prove every
+        emitted series is documented."""
+        burst_gauge_of = {
+            "burst_dispatches": "kueue_burst_dispatches",
+            "burst_cycles_decided": "kueue_burst_cycles_decided",
+            "burst_suppressed_cycles": "kueue_burst_suppressed_cycles",
+            "burst_dirty_cycles": "kueue_burst_dirty_cycles",
+            "burst_dirty_preempt": "kueue_burst_dirty_preempt",
+            "burst_dirty_scalar": "kueue_burst_dirty_scalar",
+            "burst_dirty_resume": "kueue_burst_dirty_resume",
+        }
+        walk_gauge_of = {
+            "host_cycles": "kueue_burst_host_cycles",
+            "scalar_heads": "kueue_burst_scalar_heads",
+            "resume_heads": "kueue_burst_resume_heads",
+            "walk_stop_heads": "kueue_burst_walk_stop_heads",
+            "native_ff_fallbacks": "kueue_burst_native_ff_fallbacks",
+        }
         if burst_stats:
-            for k in ("burst_dispatches", "burst_cycles_decided",
-                      "burst_suppressed_cycles", "burst_dirty_cycles",
-                      "burst_dirty_preempt", "burst_dirty_scalar",
-                      "burst_dirty_resume"):
-                self.set_gauge("kueue_" + k, (), float(burst_stats.get(k, 0)))
+            for k, gauge in burst_gauge_of.items():
+                self.set_gauge(gauge, (), float(burst_stats.get(k, 0)))
         if walk_stats:
-            for k in ("host_cycles", "scalar_heads", "resume_heads",
-                      "walk_stop_heads", "native_ff_fallbacks"):
-                self.set_gauge(f"kueue_burst_{k}", (),
-                               float(walk_stats.get(k, 0)))
+            for k, gauge in walk_gauge_of.items():
+                self.set_gauge(gauge, (), float(walk_stats.get(k, 0)))
             for reason, n in walk_stats.get("scalar_reasons", {}).items():
                 self.set_gauge("kueue_burst_scalar_heads_by_reason",
                                (reason,), float(n))
@@ -255,11 +270,17 @@ class Registry:
             for k, gauge in gauge_of.items():
                 if k in pack_stats:
                     self.set_gauge(gauge, (), float(pack_stats[k]))
+        wal_gauge_of = {
+            "wal_appends": "kueue_wal_appends",
+            "wal_commits": "kueue_wal_commits",
+            "wal_flushes": "kueue_wal_flushes",
+            "wal_fsyncs": "kueue_wal_fsyncs",
+            "wal_compactions": "kueue_wal_compactions",
+        }
         if wal_stats:
-            for k in ("wal_appends", "wal_commits", "wal_flushes",
-                      "wal_fsyncs", "wal_compactions"):
+            for k, gauge in wal_gauge_of.items():
                 if k in wal_stats:
-                    self.set_gauge("kueue_" + k, (), float(wal_stats[k]))
+                    self.set_gauge(gauge, (), float(wal_stats[k]))
 
     def report_weighted_share(self, cq: str, share: float) -> None:
         self.set_gauge("kueue_cluster_queue_weighted_share", (cq,), share)
@@ -267,70 +288,274 @@ class Registry:
     def report_cohort_weighted_share(self, cohort: str, share: float) -> None:
         self.set_gauge("kueue_cohort_weighted_share", (cohort,), share)
 
+    # -- observability-plane series (obs/: event stream + flight
+    #    recorder; sampled by Driver.refresh_resource_metrics so
+    #    /metrics always carries the current counts) --
+
+    def obs_sample(self, events_report=None, flight_recorded: int = 0) -> None:
+        """Publish the event stream's per-kind totals and the flight
+        recorder's cycle count as ``kueue_obs_*`` / ``kueue_flight_*``."""
+        if events_report:
+            for kind, n in events_report.get("counts", {}).items():
+                self.set_gauge("kueue_obs_events_total", (kind,), float(n))
+            self.set_gauge("kueue_obs_events_dropped_total", (),
+                           float(events_report.get("dropped", 0)))
+        self.set_gauge("kueue_flight_cycles_recorded", (),
+                       float(flight_recorded))
+
     # -- exposition --
 
     def render(self) -> str:
-        lines = []
-        for key, val in sorted(self.counters.items()):
-            name, *labels = key
-            lines.append(f"{name}{_fmt_labels(name, labels)} {val}")
-        for key, val in sorted(self.gauges.items()):
-            name, *labels = key
-            lines.append(f"{name}{_fmt_labels(name, labels)} {val}")
-        for key, h in sorted(self.histograms.items()):
-            name, *labels = key
-            lines.append(f"{name}_count{_fmt_labels(name, labels)} {h.n}")
-            lines.append(f"{name}_sum{_fmt_labels(name, labels)} {h.total}")
+        """Prometheus text exposition format 0.0.4: per-family ``# HELP``
+        / ``# TYPE`` headers, cumulative ``_bucket{le=...}`` series ending
+        in ``+Inf`` plus ``_sum``/``_count`` for histograms, and escaped
+        label values.  Round-trip checked against a strict parser in
+        tests/test_obs.py."""
+        families: dict[str, list] = defaultdict(list)
+        for key, val in self.counters.items():
+            families[key[0]].append((key[1:], val))
+        for key, val in self.gauges.items():
+            families[key[0]].append((key[1:], val))
+        for key, h in self.histograms.items():
+            families[key[0]].append((key[1:], h))
+        lines: list[str] = []
+        for name in sorted(families):
+            spec = SERIES.get(name)
+            kind = spec.kind if spec else (
+                "histogram" if isinstance(families[name][0][1], Histogram)
+                else "untyped")
+            help_text = spec.help if spec else name
+            lines.append(f"# HELP {name} {_escape_help(help_text)}")
+            lines.append(f"# TYPE {name} {kind}")
+            for labels, val in sorted(families[name],
+                                      key=lambda kv: kv[0]):
+                if isinstance(val, Histogram):
+                    lines.extend(_render_histogram(name, labels, val))
+                else:
+                    lines.append(
+                        f"{name}{_fmt_labels(name, labels)}"
+                        f" {_fmt_value(val)}")
         return "\n".join(lines) + "\n"
 
 
-# Label-name tables per series (reference metrics.go label definitions)
-LABEL_NAMES = {
-    "kueue_admission_attempts_total": ("result",),
-    "kueue_admission_attempt_duration_seconds": ("result",),
-    "kueue_pending_workloads": ("cluster_queue", "status"),
-    "kueue_quota_reserved_workloads_total": ("cluster_queue",),
-    "kueue_quota_reserved_wait_time_seconds": ("cluster_queue",),
-    "kueue_reserving_active_workloads": ("cluster_queue",),
-    "kueue_admitted_workloads_total": ("cluster_queue",),
-    "kueue_admission_wait_time_seconds": ("cluster_queue",),
-    "kueue_admission_checks_wait_time_seconds": ("cluster_queue",),
-    "kueue_admitted_active_workloads": ("cluster_queue",),
-    "kueue_evicted_workloads_total": ("cluster_queue", "reason"),
-    "kueue_preempted_workloads_total": ("preempting_cluster_queue", "reason"),
-    "kueue_cluster_queue_status": ("cluster_queue", "status"),
-    "kueue_cluster_queue_resource_usage":
-        ("cluster_queue", "flavor", "resource"),
-    "kueue_cluster_queue_resource_reservation":
-        ("cluster_queue", "flavor", "resource"),
-    "kueue_cluster_queue_resource_nominal_quota":
-        ("cluster_queue", "flavor", "resource"),
-    "kueue_cluster_queue_resource_borrowing_limit":
-        ("cluster_queue", "flavor", "resource"),
-    "kueue_cluster_queue_resource_lending_limit":
-        ("cluster_queue", "flavor", "resource"),
-    "kueue_cluster_queue_weighted_share": ("cluster_queue",),
-    "kueue_cohort_weighted_share": ("cohort",),
-    "kueue_local_queue_pending_workloads": ("namespace", "local_queue"),
-    "kueue_local_queue_reserving_active_workloads":
-        ("namespace", "local_queue"),
-    "kueue_local_queue_admitted_active_workloads":
-        ("namespace", "local_queue"),
-    "kueue_burst_scalar_heads_by_reason": ("reason",),
-    "kueue_open_loop_queue_depth": ("status",),
-    "kueue_open_loop_pending_age_seconds": ("quantile",),
-    "kueue_open_loop_admissions_per_second": (),
-    "kueue_open_loop_admission_latency_seconds": (),
-    "kueue_open_loop_requeue_storm_size": (),
-    "kueue_open_loop_requeue_storm_peak": (),
+@dataclass(frozen=True)
+class Series:
+    """One documented metric family: exposition type, label names in
+    emission order, and the HELP string."""
+    name: str
+    kind: str            # "counter" | "gauge" | "histogram"
+    labels: tuple
+    help: str
+
+
+# Every series this registry emits, in one place.  The metrics-doc lint
+# (analysis/metrics_doc.py) proves two invariants statically: every
+# ``kueue_*`` string literal in this module names a row here, and this
+# table matches the README "## Metrics" table in both directions.
+_SERIES_DEFS = [
+    # reference pkg/metrics parity
+    ("kueue_admission_attempts_total", "counter", ("result",),
+     "Admission attempts by result (success / inadmissible)."),
+    ("kueue_admission_attempt_duration_seconds", "histogram", ("result",),
+     "Latency of one admission attempt, by result."),
+    ("kueue_admission_cycle_preemption_skips", "counter", (),
+     "Workloads skipped in a cycle because preemption was still pending."),
+    ("kueue_pending_workloads", "gauge", ("cluster_queue", "status"),
+     "Pending workloads per cluster queue, by active/inadmissible status."),
+    ("kueue_quota_reserved_workloads_total", "counter", ("cluster_queue",),
+     "Workloads that reserved quota, cumulative per cluster queue."),
+    ("kueue_quota_reserved_wait_time_seconds", "histogram",
+     ("cluster_queue",),
+     "Wait from creation to quota reservation."),
+    ("kueue_reserving_active_workloads", "gauge", ("cluster_queue",),
+     "Workloads currently holding a quota reservation."),
+    ("kueue_admitted_workloads_total", "counter", ("cluster_queue",),
+     "Admitted workloads, cumulative per cluster queue."),
+    ("kueue_admission_wait_time_seconds", "histogram", ("cluster_queue",),
+     "Wait from creation to admission."),
+    ("kueue_admission_checks_wait_time_seconds", "histogram",
+     ("cluster_queue",),
+     "Wait from quota reservation to all admission checks ready."),
+    ("kueue_admitted_active_workloads", "gauge", ("cluster_queue",),
+     "Workloads currently admitted."),
+    ("kueue_evicted_workloads_total", "counter", ("cluster_queue", "reason"),
+     "Evictions by cluster queue and reason."),
+    ("kueue_preempted_workloads_total", "counter",
+     ("preempting_cluster_queue", "reason"),
+     "Preemptions by preempting cluster queue and reason."),
+    ("kueue_cluster_queue_status", "gauge", ("cluster_queue", "status"),
+     "Cluster queue status one-hot (pending / active / terminating)."),
+    ("kueue_cluster_queue_resource_usage", "gauge",
+     ("cluster_queue", "flavor", "resource"),
+     "Admitted resource usage per cluster queue, flavor, and resource."),
+    ("kueue_cluster_queue_resource_reservation", "gauge",
+     ("cluster_queue", "flavor", "resource"),
+     "Reserved (incl. non-admitted) quota per cluster queue and flavor."),
+    ("kueue_cluster_queue_resource_nominal_quota", "gauge",
+     ("cluster_queue", "flavor", "resource"),
+     "Configured nominal quota per cluster queue and flavor."),
+    ("kueue_cluster_queue_resource_borrowing_limit", "gauge",
+     ("cluster_queue", "flavor", "resource"),
+     "Configured borrowing limit, when set."),
+    ("kueue_cluster_queue_resource_lending_limit", "gauge",
+     ("cluster_queue", "flavor", "resource"),
+     "Configured lending limit, when set."),
+    ("kueue_cluster_queue_weighted_share", "gauge", ("cluster_queue",),
+     "Fair-sharing weighted share per cluster queue."),
+    ("kueue_cohort_weighted_share", "gauge", ("cohort",),
+     "Fair-sharing weighted share per cohort."),
+    ("kueue_local_queue_pending_workloads", "gauge",
+     ("namespace", "local_queue"),
+     "Pending workloads per local queue (LocalQueueMetrics gate)."),
+    ("kueue_local_queue_reserving_active_workloads", "gauge",
+     ("namespace", "local_queue"),
+     "Reserving workloads per local queue (LocalQueueMetrics gate)."),
+    ("kueue_local_queue_admitted_active_workloads", "gauge",
+     ("namespace", "local_queue"),
+     "Admitted workloads per local queue (LocalQueueMetrics gate)."),
+    # open-loop traffic soak
+    ("kueue_open_loop_queue_depth", "gauge", ("status",),
+     "Open-loop soak queue depth by active/inadmissible status."),
+    ("kueue_open_loop_pending_age_seconds", "gauge", ("quantile",),
+     "Open-loop pending-age quantiles (p50/p99), virtual seconds."),
+    ("kueue_open_loop_admissions_per_second", "gauge", (),
+     "Achieved open-loop admission rate."),
+    ("kueue_open_loop_admission_latency_seconds", "histogram", (),
+     "Submit-to-admit latency in the open-loop soak, virtual seconds."),
+    ("kueue_open_loop_requeue_storm_size", "histogram", (),
+     "Workloads unparked per cohort wakeup."),
+    ("kueue_open_loop_requeue_storm_peak", "gauge", (),
+     "Largest requeue storm observed."),
+    # burst solver + flavor walk
+    ("kueue_burst_dispatches", "gauge", (),
+     "Fused burst-kernel dispatches."),
+    ("kueue_burst_cycles_decided", "gauge", (),
+     "Cycles decided on-device by the burst solver."),
+    ("kueue_burst_suppressed_cycles", "gauge", (),
+     "Burst cycles suppressed by the dirty-set check."),
+    ("kueue_burst_dirty_cycles", "gauge", (),
+     "Burst cycles invalidated and replayed on host."),
+    ("kueue_burst_dirty_preempt", "gauge", (),
+     "Burst invalidations caused by preemption."),
+    ("kueue_burst_dirty_scalar", "gauge", (),
+     "Burst invalidations caused by scalar-path heads."),
+    ("kueue_burst_dirty_resume", "gauge", (),
+     "Burst invalidations caused by resume heads."),
+    ("kueue_burst_host_cycles", "gauge", (),
+     "Cycles that fell back to the host solver."),
+    ("kueue_burst_scalar_heads", "gauge", (),
+     "Heads routed to the scalar path."),
+    ("kueue_burst_resume_heads", "gauge", (),
+     "Heads resumed mid-walk after a preempting flavor."),
+    ("kueue_burst_walk_stop_heads", "gauge", (),
+     "Heads whose flavor walk stopped early."),
+    ("kueue_burst_native_ff_fallbacks", "gauge", (),
+     "Flavor-fungibility configs the native kernel could not encode."),
+    ("kueue_burst_scalar_heads_by_reason", "gauge", ("reason",),
+     "Scalar-path heads broken down by routing reason."),
+    # streaming pack + arena + WAL
+    ("kueue_pack_stream_packs", "gauge", (),
+     "Streaming (delta) pack invocations."),
+    ("kueue_pack_full_packs", "gauge", (),
+     "Full repacks (stream path unavailable or bailed)."),
+    ("kueue_pack_stream_bails", "gauge", (),
+     "Streaming packs that bailed to a full repack."),
+    ("kueue_pack_host_seconds", "gauge", (),
+     "Cumulative host seconds spent packing."),
+    ("kueue_pack_last_ms", "gauge", (),
+     "Duration of the most recent pack, milliseconds."),
+    ("kueue_pack_row_patches", "gauge", (),
+     "Arena row patches applied by streaming packs."),
+    ("kueue_pack_rows_verified", "gauge", (),
+     "Arena rows verified against a full repack."),
+    ("kueue_pack_rank_patches", "gauge", (),
+     "Rank-plane patches applied by streaming packs."),
+    ("kueue_pack_arena_growth_events", "gauge", (),
+     "Times the pinned arena had to grow."),
+    ("kueue_pack_arena_planes", "gauge", (),
+     "Planes resident in the pinned arena."),
+    ("kueue_pack_arena_bytes", "gauge", (),
+     "Pinned arena capacity, bytes."),
+    ("kueue_pack_arena_used_bytes", "gauge", (),
+     "Pinned arena bytes in use."),
+    ("kueue_pack_tighten_bytes_saved", "gauge", (),
+     "Bytes saved by dtype tightening."),
+    ("kueue_pack_tighten_widened", "gauge", (),
+     "Planes widened back after a tightening overflow."),
+    ("kueue_pack_bytes_to_device", "gauge", (),
+     "Host-to-device bytes shipped per burst launch."),
+    ("kueue_wal_appends", "gauge", (),
+     "WAL operation records appended."),
+    ("kueue_wal_commits", "gauge", (),
+     "WAL cycle commits."),
+    ("kueue_wal_flushes", "gauge", (),
+     "WAL buffered-write flushes."),
+    ("kueue_wal_fsyncs", "gauge", (),
+     "WAL fsync calls."),
+    ("kueue_wal_compactions", "gauge", (),
+     "WAL checkpoint compactions."),
+    # observability plane (obs/)
+    ("kueue_span_duration_seconds", "histogram", ("phase",),
+     "Traced hot-path phase durations (obs tracer), wall seconds."),
+    ("kueue_obs_events_total", "gauge", ("kind",),
+     "Events emitted, by kind (admit/evict/preempt/requeue/eject)."),
+    ("kueue_obs_events_dropped_total", "gauge", (),
+     "Events dropped from the bounded stream after overflow."),
+    ("kueue_flight_cycles_recorded", "gauge", (),
+     "Cycles recorded by the flight recorder, cumulative."),
+]
+
+SERIES: dict[str, Series] = {
+    name: Series(name, kind, labels, help)
+    for name, kind, labels, help in _SERIES_DEFS
 }
 
+# Label-name tables per series, derived from SERIES (reference
+# metrics.go label definitions).
+LABEL_NAMES = {s.name: s.labels for s in SERIES.values() if s.labels}
 
-def _fmt_labels(name: str, labels: list) -> str:
-    if not labels:
+
+def _escape_label(value) -> str:
+    return (str(value).replace("\\", r"\\").replace('"', r'\"')
+            .replace("\n", r"\n"))
+
+
+def _escape_help(text: str) -> str:
+    return text.replace("\\", r"\\").replace("\n", r"\n")
+
+
+def _fmt_value(val: float) -> str:
+    f = float(val)
+    return str(int(f)) if f == int(f) and abs(f) < 1e15 else repr(f)
+
+
+def _fmt_labels(name: str, labels, extra: str = "") -> str:
+    if not labels and not extra:
         return ""
     names = LABEL_NAMES.get(name)
-    parts = ",".join(
-        f'{names[i] if names and i < len(names) else f"l{i}"}="{v}"'
-        for i, v in enumerate(labels))
-    return "{" + parts + "}"
+    parts = [
+        f'{names[i] if names and i < len(names) else f"l{i}"}'
+        f'="{_escape_label(v)}"'
+        for i, v in enumerate(labels)]
+    if extra:
+        parts.append(extra)
+    return "{" + ",".join(parts) + "}"
+
+
+def _render_histogram(name: str, labels, h: Histogram) -> list[str]:
+    lines = []
+    cum = 0
+    for i, b in enumerate(h.buckets):
+        cum += h.counts[i]
+        le = _fmt_value(b) if float(b) == int(b) else repr(float(b))
+        extra = 'le="' + le + '"'
+        lines.append(f"{name}_bucket"
+                     f"{_fmt_labels(name, labels, extra)} {cum}")
+    cum += h.counts[-1]
+    inf_extra = 'le="+Inf"'
+    lines.append(f"{name}_bucket"
+                 f"{_fmt_labels(name, labels, inf_extra)} {cum}")
+    lines.append(f"{name}_sum{_fmt_labels(name, labels)}"
+                 f" {_fmt_value(h.total)}")
+    lines.append(f"{name}_count{_fmt_labels(name, labels)} {h.n}")
+    return lines

@@ -1,0 +1,78 @@
+"""Bounded, subscribable event stream: the Kubernetes Events analog.
+
+The reference emits Events (``record.EventRecorder``) for every
+workload transition; here :class:`EventStream` is the in-process
+equivalent: the driver pushes one :class:`Event` per admit / evict /
+preempt / requeue / eject, with the reason and the object refs, into a
+bounded ring.  Consumers either subscribe (the flight recorder does)
+or read the tail (``/debug/flightrecorder``, soak artifacts).
+
+The stream is deliberately decision-free: pushing an event reads no
+clock and mutates nothing outside the ring, so an attached stream can
+never perturb scheduling.  Overflow drops the *oldest* event and
+counts the drop — the per-kind totals keep counting regardless, so
+artifact counts stay exact even past capacity.
+"""
+
+from __future__ import annotations
+
+from collections import deque
+from dataclasses import dataclass, field
+from typing import Callable, Optional
+
+#: Every kind the driver/federation layer emits.
+EVENT_KINDS = ("admit", "evict", "preempt", "requeue", "eject")
+
+
+@dataclass
+class Event:
+    kind: str           # one of EVENT_KINDS
+    key: str            # workload key ("ns/name")
+    cluster_queue: str  # CQ involved ("" when unknown)
+    reason: str         # reason string (eviction reason, check name, …)
+    note: str = ""      # free-form detail
+    cycle: int = 0      # scheduling cycle at emission (0 = outside one)
+    vt: float = 0.0     # virtual-clock reading at emission
+
+
+class EventStream:
+    """Bounded ring of :class:`Event` + per-kind running totals."""
+
+    def __init__(self, capacity: int = 4096):
+        self.capacity = max(1, int(capacity))
+        self.ring: deque[Event] = deque(maxlen=self.capacity)
+        self.counts: dict[str, int] = {k: 0 for k in EVENT_KINDS}
+        self.dropped = 0
+        self.total = 0
+        self._subscribers: list[Callable[[Event], None]] = []
+
+    def emit(self, kind: str, key: str, cluster_queue: str = "",
+             reason: str = "", note: str = "", cycle: int = 0,
+             vt: float = 0.0) -> Event:
+        ev = Event(kind=kind, key=key, cluster_queue=cluster_queue,
+                   reason=reason, note=note, cycle=cycle, vt=vt)
+        if len(self.ring) == self.capacity:
+            self.dropped += 1
+        self.ring.append(ev)
+        self.counts[kind] = self.counts.get(kind, 0) + 1
+        self.total += 1
+        for fn in self._subscribers:
+            fn(ev)
+        return ev
+
+    def subscribe(self, fn: Callable[[Event], None]) -> None:
+        self._subscribers.append(fn)
+
+    def tail(self, n: Optional[int] = None) -> list[Event]:
+        evs = list(self.ring)
+        return evs if n is None else evs[-n:]
+
+    def report(self) -> dict:
+        """The ``events`` block for artifacts and dumps."""
+        return {
+            "counts": {k: v for k, v in sorted(self.counts.items()) if v},
+            "total": self.total,
+            "dropped": self.dropped,
+            "capacity": self.capacity,
+            "buffered": len(self.ring),
+        }

@@ -1,0 +1,115 @@
+"""Cycle flight recorder: the last N cycles, dumpable at any instant.
+
+The ``pkg/debugger`` analog, upgraded from "print the queue heads" to a
+bounded ring of :class:`CycleRecord` — one per applied scheduling cycle,
+carrying the cycle's decision digest (what was admitted / preempted /
+evicted, hashed and listed), the spans the tracer finished during the
+cycle, the chaos hit counters, and both clocks.  Every debugging war
+story so far was reconstructed after the fact from artifacts; the
+recorder makes the same reconstruction available live, mid-soak, from
+``/debug/flightrecorder`` or ``kill -USR2``.
+
+Dump discipline: ``dump()`` renders from a shallow snapshot of the ring
+taken up front, and the ``obs.dump`` chaos crashpoint sits *after* the
+snapshot but *before* serialization — a crash mid-dump can therefore
+never leave the recorder half-mutated (recording appends are the only
+writes, and dump never writes).  The chaos suite proves a re-dump after
+an injected mid-dump crash is identical to an undisturbed dump.
+"""
+
+from __future__ import annotations
+
+import hashlib
+from collections import deque
+from dataclasses import dataclass, field
+from typing import Optional
+
+from ..chaos import injector as _chaos
+
+
+def decision_digest(stats) -> str:
+    """Stable short hash of one cycle's decision batch (CycleStats)."""
+    h = hashlib.sha256()
+    for part in (stats.admitted, stats.preempting, stats.skipped,
+                 stats.inadmissible, stats.preempted_targets):
+        h.update(("|".join(part) + ";").encode())
+    return h.hexdigest()[:16]
+
+
+@dataclass
+class CycleRecord:
+    cycle: int                    # scheduler.scheduling_cycle
+    digest: str                   # decision_digest(stats)
+    admitted: list[str]
+    preempting: list[str]
+    evicted: list[str]            # preempted targets this cycle
+    duration_s: float
+    vt: float                     # virtual clock at record time
+    spans: list = field(default_factory=list)        # SpanRecord list
+    chaos_hits: dict = field(default_factory=dict)   # site -> hit count
+    events: int = 0               # event-stream total at record time
+
+
+class FlightRecorder:
+    """Bounded ring of the last ``capacity`` cycle records."""
+
+    def __init__(self, capacity: int = 256):
+        self.capacity = max(1, int(capacity))
+        self.ring: deque[CycleRecord] = deque(maxlen=self.capacity)
+        self.recorded_total = 0
+        self.dumps = 0
+
+    def record(self, stats, vt: float = 0.0, spans=None,
+               events_total: int = 0) -> CycleRecord:
+        """Append one applied cycle.  ``spans`` is the tracer's drained
+        cycle buffer (empty when tracing is off)."""
+        chaos_hits = (dict(_chaos.ACTIVE.counts)
+                      if _chaos.ACTIVE is not None else {})
+        rec = CycleRecord(
+            cycle=stats.cycle,
+            digest=decision_digest(stats),
+            admitted=list(stats.admitted),
+            preempting=list(stats.preempting),
+            evicted=list(stats.preempted_targets),
+            duration_s=stats.duration_s,
+            vt=vt,
+            spans=list(spans or ()),
+            chaos_hits=chaos_hits,
+            events=events_total)
+        self.ring.append(rec)
+        self.recorded_total += 1
+        return rec
+
+    def last(self) -> Optional[CycleRecord]:
+        return self.ring[-1] if self.ring else None
+
+    def dump(self, tail: Optional[int] = None) -> dict:
+        """Serialize the ring (newest last).  Reads a snapshot first;
+        the ``obs.dump`` crashpoint then models a crash mid-dump —
+        after the snapshot, before serialization — so the chaos suite
+        can prove dumping never corrupts the recorder."""
+        snapshot = list(self.ring)
+        if tail is not None:
+            snapshot = snapshot[-tail:]
+        if _chaos.ACTIVE is not None:
+            _chaos.ACTIVE.crashpoint("obs.dump")
+        self.dumps += 1
+        return {
+            "capacity": self.capacity,
+            "recorded_total": self.recorded_total,
+            "buffered": len(snapshot),
+            "cycles": [{
+                "cycle": r.cycle,
+                "digest": r.digest,
+                "admitted": r.admitted,
+                "preempting": r.preempting,
+                "evicted": r.evicted,
+                "duration_s": r.duration_s,
+                "virtual_time": r.vt,
+                "events_total": r.events,
+                "chaos_hits": r.chaos_hits,
+                "spans": [{"name": s.name, "dur_s": s.dur,
+                           "depth": s.depth, "parent": s.parent}
+                          for s in r.spans],
+            } for r in snapshot],
+        }

@@ -1,0 +1,290 @@
+"""Structured span tracer for the admission hot path.
+
+The tracer follows the chaos-injector pattern: a module-global
+``ACTIVE`` that every instrumented site consults.  Tracing off
+(``ACTIVE is None``) costs one module-attribute read and a ``with`` on
+a shared no-op singleton — no allocation, no clock read, no branch into
+tracer code.  Tracing on, each ``span(name)``:
+
+- reads the wall clock (``time.perf_counter``) at entry and exit,
+- reads the *virtual* clock (the driver's ``clock``) once at entry when
+  one is attached — a pure read, never a tick, so traced and untraced
+  runs make bit-identical decisions,
+- feeds the duration into the registry's per-phase exponential-bucket
+  histogram (``kueue_span_duration_seconds{phase=...}``),
+- appends a finished-span record to the current cycle buffer, which the
+  flight recorder drains at each cycle boundary (``counted=True``
+  leaves skip the record and keep histogram-only timing — see
+  :func:`span`).
+
+Spans nest via an explicit stack; ``Span.__exit__`` enforces LIFO
+pairing (a span may close exactly once, and only when it is the
+innermost open span), so malformed instrumentation fails loudly in
+tests instead of producing silently garbled traces.
+
+``to_chrome_trace`` renders finished spans as Chrome trace-event JSON
+(``ph: "X"`` complete events, microsecond timestamps) so ``/debug/spans``
+output opens in Perfetto next to ``jax.profiler`` traces from
+``profiling.py``.
+"""
+
+from __future__ import annotations
+
+import time
+from dataclasses import dataclass
+from typing import Callable, Optional
+
+from ..metrics import Histogram, Registry, exponential_buckets
+
+#: Exponential buckets for per-phase span durations: 1µs .. ~4s.
+SPAN_BUCKETS = exponential_buckets(1e-6, 2, 22)
+
+#: Every phase the hot path is instrumented with, in call order.  The
+#: OBS artifact's span roster and the SIGUSR2 dump are checked against
+#: this list; adding an instrumentation site means adding its name here.
+HOT_PATH_PHASES = (
+    "cycle",            # one whole scheduling cycle (schedule_once path)
+    "cycle.snapshot",   # cache snapshot build / incremental reuse
+    "cycle.nominate",   # validation + flavor assignment + preempt targets
+    "cycle.order",      # classical sort or fair-sharing tournament setup
+    "cycle.admit",      # sequential admit loop (assume/apply/requeue)
+    "burst.pack",       # burst-window pack (streaming or classic delta)
+    "burst.dispatch",   # fused-kernel launch incl. sharded shard launches
+    "burst.fetch",      # decision-plane fetch (flags + full planes)
+    "burst.apply",      # host apply of one modeled burst cycle
+    "wal.append",       # one journal op append
+    "wal.commit",       # cycle-boundary commit (group commit included)
+    "wal.compact",      # checkpoint + tail rewrite
+    "fed.sync",         # one federation reconcile/sync step
+)
+
+
+@dataclass(slots=True)
+class SpanRecord:
+    """One finished span."""
+    name: str
+    t0: float           # wall clock at entry (perf_counter seconds)
+    dur: float          # wall-clock duration, seconds
+    depth: int          # nesting depth at entry (0 = top level)
+    parent: str         # name of the enclosing span ("" at top level)
+    vt: float           # virtual-clock reading at entry (0.0 if none)
+
+
+class Span:
+    """A single open span; re-usable only after it closed.
+
+    The tracer pools one instance per nesting depth — LIFO pairing
+    means the slot for the current depth is always closed when
+    ``span()`` hands it out again, so the steady-state hot path
+    allocates no span objects at all."""
+
+    __slots__ = ("tracer", "name", "t0", "depth", "parent", "vt",
+                 "_open")
+
+    def __init__(self, tracer: "Tracer", name: str = ""):
+        self.tracer = tracer
+        self.name = name
+        self._open = False
+
+    def __enter__(self) -> "Span":
+        if self._open:
+            raise RuntimeError(f"span {self.name!r} entered twice")
+        st = self.tracer._stack
+        self.depth = len(st)
+        self.parent = st[-1].name if st else ""
+        self.vt = self.tracer.vclock() if self.tracer.vclock else 0.0
+        st.append(self)
+        self._open = True
+        self.t0 = time.perf_counter()
+        return self
+
+    def __exit__(self, exc_type, exc, tb) -> bool:
+        st = self.tracer._stack
+        if not self._open or not st or st[-1] is not self:
+            raise RuntimeError(
+                f"span {self.name!r} closed out of order "
+                f"(stack: {[s.name for s in st]})")
+        dur = time.perf_counter() - self.t0
+        st.pop()
+        self._open = False
+        self.tracer._finish(self, dur)
+        return False            # never swallow the exception
+
+
+class _CountedSpan:
+    """Histogram-only leaf span: times every entry into the phase
+    histogram but skips the stack, parent/depth bookkeeping, the
+    virtual-clock read, and the retained record.  By contract counted
+    spans are leaves and must not nest inside one another (each tracer
+    reuses a single instance per depth-free site)."""
+
+    __slots__ = ("tracer", "name", "t0")
+
+    def __init__(self, tracer: "Tracer"):
+        self.tracer = tracer
+        self.name = ""
+
+    def __enter__(self) -> "_CountedSpan":
+        self.t0 = time.perf_counter()
+        return self
+
+    def __exit__(self, exc_type, exc, tb) -> bool:
+        dur = time.perf_counter() - self.t0
+        tr = self.tracer
+        tr.finished_total += 1
+        h = tr._hists.get(self.name)
+        if h is None:
+            h = tr._hist_for(self.name)
+        h.observe(dur)
+        return False            # never swallow the exception
+
+
+class _NoopSpan:
+    """Shared do-nothing span: what ``span(...)`` hands out when
+    tracing is off.  A single module-level instance — entering it
+    allocates nothing and touches no clock."""
+
+    __slots__ = ()
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, exc_type, exc, tb) -> bool:
+        return False
+
+
+_NOOP = _NoopSpan()
+
+
+class Tracer:
+    """Collects spans into per-phase histograms + a per-cycle buffer.
+
+    ``registry`` receives the ``kueue_span_duration_seconds`` series;
+    ``vclock`` is an optional side-effect-free callable returning the
+    scenario's virtual time (the driver's ``clock``).  The tracer keeps
+    every finished span of the *current* cycle in ``cycle_spans`` until
+    the flight recorder drains it; total counts survive draining."""
+
+    def __init__(self, registry: Optional[Registry] = None,
+                 vclock: Optional[Callable[[], float]] = None):
+        self.registry = registry if registry is not None else Registry()
+        self.vclock = vclock
+        self._stack: list[Span] = []
+        self._pool: list[Span] = []      # one reusable span per depth
+        self._counted = _CountedSpan(self)   # shared histogram-only leaf
+        self._hists: dict[str, Histogram] = {}   # phase -> registry hist
+        self.cycle_spans: list[SpanRecord] = []
+        self.finished_total = 0
+        self.opened_total = 0
+        # retained finished spans for /debug/spans (bounded)
+        self.trace_spans: list[SpanRecord] = []
+        self.trace_capacity = 4096
+
+    def span(self, name: str, counted: bool = False):
+        self.opened_total += 1
+        if counted:
+            s = self._counted
+            s.name = name
+            return s
+        pool = self._pool
+        d = len(self._stack)
+        if d >= len(pool):
+            pool.append(Span(self))
+        s = pool[d]
+        if s._open:     # a held handle mid-misuse: never rename it
+            s = Span(self)
+        s.name = name
+        return s
+
+    def _hist_for(self, name: str) -> Histogram:
+        # same series/key shape Registry.observe would create, the
+        # dict probes amortised away from the per-span path
+        key = ("kueue_span_duration_seconds", name)
+        h = self.registry.histograms.get(key)
+        if h is None:
+            h = Histogram(buckets=SPAN_BUCKETS)
+            self.registry.histograms[key] = h
+        self._hists[name] = h
+        return h
+
+    def _finish(self, s: Span, dur: float) -> None:
+        self.finished_total += 1
+        rec = SpanRecord(s.name, s.t0, dur, s.depth, s.parent, s.vt)
+        self.cycle_spans.append(rec)
+        if len(self.trace_spans) < self.trace_capacity:
+            self.trace_spans.append(rec)
+        h = self._hists.get(s.name)
+        if h is None:
+            h = self._hist_for(s.name)
+        h.observe(dur)
+
+    def drain_cycle(self) -> list[SpanRecord]:
+        out, self.cycle_spans = self.cycle_spans, []
+        return out
+
+    def open_spans(self) -> list[str]:
+        return [s.name for s in self._stack]
+
+    # -- reporting -----------------------------------------------------
+
+    def roster(self) -> dict[str, dict]:
+        """Per-phase count/p50/p99 from the registry histograms, for
+        artifacts and the flight-recorder dump."""
+        out: dict[str, dict] = {}
+        for key, h in sorted(self.registry.histograms.items()):
+            if key[0] != "kueue_span_duration_seconds":
+                continue
+            phase = key[1]
+            out[phase] = {
+                "count": h.n,
+                "p50_ms": h.quantile(0.5) * 1000.0,
+                "p99_ms": h.quantile(0.99) * 1000.0,
+                "total_s": h.total,
+            }
+        return out
+
+
+#: The process-wide tracer every span site consults.  None = off.
+ACTIVE: Optional[Tracer] = None
+
+
+def install(tracer: Optional[Tracer]) -> Optional[Tracer]:
+    global ACTIVE
+    ACTIVE = tracer
+    return tracer
+
+
+def clear() -> None:
+    install(None)
+
+
+def span(name: str, counted: bool = False):
+    """The one instrumentation entry point: a context manager that is
+    a real span when tracing is on and the shared no-op otherwise.
+
+    ``counted=True`` marks an ultra-hot leaf (per-op WAL appends: the
+    operation itself is ~2µs, so a retained record would out-cost it):
+    every entry is still timed into the phase histogram — roster
+    counts and percentiles stay exact — but no SpanRecord lands in the
+    cycle buffer or the Chrome trace."""
+    t = ACTIVE
+    return t.span(name, counted) if t is not None else _NOOP
+
+
+def to_chrome_trace(spans) -> dict:
+    """Chrome trace-event JSON (the ``chrome://tracing`` / Perfetto
+    format): one complete ("X") event per finished span, microsecond
+    wall-clock timestamps, virtual time and depth in ``args``."""
+    events = []
+    for s in spans:
+        events.append({
+            "name": s.name,
+            "ph": "X",
+            "ts": s.t0 * 1e6,
+            "dur": s.dur * 1e6,
+            "pid": 1,
+            "tid": 1,
+            "args": {"virtual_time": s.vt, "depth": s.depth,
+                     "parent": s.parent},
+        })
+    return {"traceEvents": events, "displayTimeUnit": "ms"}

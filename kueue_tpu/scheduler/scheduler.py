@@ -152,18 +152,103 @@ class Scheduler:
             heads = self.queues.heads_nonblocking()
         if not heads:
             return stats
+        from ..obs.trace import span as _span
         from ..profiling import cycle_step
-        with cycle_step(self.scheduling_cycle):
+        with cycle_step(self.scheduling_cycle), _span("cycle"):
             return self._run_cycle(heads, stats, start)
 
     def _run_cycle(self, heads: list[Info], stats: CycleStats,
                    start: float) -> CycleStats:
+        from ..obs.trace import span as _span
         self._cycle_blocked = self.admission_blocked()
-        snapshot = self.cache.snapshot()
-        entries = self.nominate(heads, snapshot)
-        device_final = self._maybe_solve_on_device(entries, snapshot)
+        with _span("cycle.snapshot"):
+            snapshot = self.cache.snapshot()
+        with _span("cycle.nominate"):
+            entries = self.nominate(heads, snapshot)
+            device_final = self._maybe_solve_on_device(entries, snapshot)
         if device_final is not None:
-            self._admit_device_cycle(device_final, snapshot, stats)
+            with _span("cycle.admit"):
+                self._admit_device_cycle(device_final, snapshot, stats)
+                for e in entries:
+                    if e.status != EntryStatus.ASSUMED:
+                        self._requeue_and_update(e)
+                        if e.status == EntryStatus.SKIPPED:
+                            stats.skipped.append(e.info.key)
+                        else:
+                            stats.inadmissible.append(e.info.key)
+            self._rewake_if_gate_opened()
+            stats.duration_s = self.clock() - start
+            return stats
+        with _span("cycle.order"):
+            iterator = self._make_iterator(entries, snapshot)
+
+        preempted_workloads: dict[str, Info] = {}
+        with _span("cycle.admit"):
+            for e in iterator:
+                cq = snapshot.cq(e.info.cluster_queue)
+                mode = e.assignment.representative_mode()
+                if mode == Mode.NO_FIT:
+                    continue
+
+                if mode == Mode.PREEMPT and not e.preemption_targets:
+                    # reserve capacity so lower-priority entries can't jump ahead
+                    if cq is not None:
+                        usage = self._resources_to_reserve(e, cq)
+                        cq.simulate_usage_addition(usage)  # revert discarded: snapshot-local
+                        self._note_fs_usage(e.info.cluster_queue, usage)
+                    continue
+
+                if any(t.info.key in preempted_workloads
+                       for t in e.preemption_targets):
+                    self._set_skipped(e, "Workload has overlapping preemption "
+                                         "targets with another workload")
+                    if self.metrics is not None:
+                        self.metrics.cycle_preemption_skip()
+                    continue
+
+                usage = e.assignment.usage
+                if not self._fits(cq, usage, preempted_workloads,
+                                  e.preemption_targets):
+                    self._set_skipped(e, "Workload no longer fits after "
+                                         "processing another workload")
+                    continue
+                for t in e.preemption_targets:
+                    preempted_workloads[t.info.key] = t.info
+                cq.simulate_usage_addition(usage)
+                self._note_fs_usage(e.info.cluster_queue, usage)
+
+                if e.assignment.representative_mode() == Mode.PREEMPT:
+                    e.info.last_assignment = None  # retry all flavors next time
+                    preempted = self.preemptor.issue_preemptions(
+                        e.info, e.preemption_targets)
+                    if preempted:
+                        e.inadmissible_msg += (f". Pending the preemption of "
+                                               f"{preempted} workload(s)")
+                        e.requeue_reason = RequeueReason.PENDING_PREEMPTION
+                    stats.preempting.append(e.info.key)
+                    stats.preempted_targets.extend(
+                        t.info.key for t in e.preemption_targets)
+                    continue
+
+                if self._cycle_blocked:
+                    # blockAdmission: usage stays consumed for this cycle
+                    # (the reference would wait-then-admit here); the entry
+                    # requeues and the PodsReady transition wakes it
+                    e.inadmissible_msg = ("Waiting for all admitted workloads "
+                                          "to be in the PodsReady condition")
+                    self.gate_parked = True
+                    continue
+                e.status = EntryStatus.NOMINATED
+                if self._admit(e, cq):
+                    stats.admitted.append(e.info.key)
+                    # re-check per admission: the workload just admitted is
+                    # itself not PodsReady yet, so with blockAdmission at
+                    # most one admission lands per cycle (scheduler.go:268
+                    # checks PodsReadyForAllAdmittedWorkloads per entry)
+                    self._cycle_blocked = self.admission_blocked()
+                else:
+                    e.inadmissible_msg = "Failed to admit workload"
+
             for e in entries:
                 if e.status != EntryStatus.ASSUMED:
                     self._requeue_and_update(e)
@@ -171,80 +256,6 @@ class Scheduler:
                         stats.skipped.append(e.info.key)
                     else:
                         stats.inadmissible.append(e.info.key)
-            self._rewake_if_gate_opened()
-            stats.duration_s = self.clock() - start
-            return stats
-        iterator = self._make_iterator(entries, snapshot)
-
-        preempted_workloads: dict[str, Info] = {}
-        for e in iterator:
-            cq = snapshot.cq(e.info.cluster_queue)
-            mode = e.assignment.representative_mode()
-            if mode == Mode.NO_FIT:
-                continue
-
-            if mode == Mode.PREEMPT and not e.preemption_targets:
-                # reserve capacity so lower-priority entries can't jump ahead
-                if cq is not None:
-                    usage = self._resources_to_reserve(e, cq)
-                    cq.simulate_usage_addition(usage)  # revert discarded: snapshot-local
-                    self._note_fs_usage(e.info.cluster_queue, usage)
-                continue
-
-            if any(t.info.key in preempted_workloads for t in e.preemption_targets):
-                self._set_skipped(e, "Workload has overlapping preemption "
-                                     "targets with another workload")
-                if self.metrics is not None:
-                    self.metrics.cycle_preemption_skip()
-                continue
-
-            usage = e.assignment.usage
-            if not self._fits(cq, usage, preempted_workloads, e.preemption_targets):
-                self._set_skipped(e, "Workload no longer fits after "
-                                     "processing another workload")
-                continue
-            for t in e.preemption_targets:
-                preempted_workloads[t.info.key] = t.info
-            cq.simulate_usage_addition(usage)
-            self._note_fs_usage(e.info.cluster_queue, usage)
-
-            if e.assignment.representative_mode() == Mode.PREEMPT:
-                e.info.last_assignment = None  # retry all flavors next time
-                preempted = self.preemptor.issue_preemptions(e.info, e.preemption_targets)
-                if preempted:
-                    e.inadmissible_msg += (f". Pending the preemption of "
-                                           f"{preempted} workload(s)")
-                    e.requeue_reason = RequeueReason.PENDING_PREEMPTION
-                stats.preempting.append(e.info.key)
-                stats.preempted_targets.extend(t.info.key for t in e.preemption_targets)
-                continue
-
-            if self._cycle_blocked:
-                # blockAdmission: usage stays consumed for this cycle
-                # (the reference would wait-then-admit here); the entry
-                # requeues and the PodsReady transition wakes it
-                e.inadmissible_msg = ("Waiting for all admitted workloads "
-                                      "to be in the PodsReady condition")
-                self.gate_parked = True
-                continue
-            e.status = EntryStatus.NOMINATED
-            if self._admit(e, cq):
-                stats.admitted.append(e.info.key)
-                # re-check per admission: the workload just admitted is
-                # itself not PodsReady yet, so with blockAdmission at
-                # most one admission lands per cycle (scheduler.go:268
-                # checks PodsReadyForAllAdmittedWorkloads per entry)
-                self._cycle_blocked = self.admission_blocked()
-            else:
-                e.inadmissible_msg = "Failed to admit workload"
-
-        for e in entries:
-            if e.status != EntryStatus.ASSUMED:
-                self._requeue_and_update(e)
-                if e.status == EntryStatus.SKIPPED:
-                    stats.skipped.append(e.info.key)
-                else:
-                    stats.inadmissible.append(e.info.key)
         self._rewake_if_gate_opened()
         stats.duration_s = self.clock() - start
         return stats

@@ -272,25 +272,31 @@ class CycleWAL:
     # -- writing --
 
     def log(self, op: dict) -> None:
-        if self._open is None:
-            self._open = []
-        self._open.append(op)
-        self._emit(dict(op, wal="op"))
+        from ..obs.trace import span as _span
+        # counted leaf: per-op appends are ~2µs, a retained record
+        # would cost more than the op — histogram-only timing
+        with _span("wal.append", counted=True):
+            if self._open is None:
+                self._open = []
+            self._open.append(op)
+            self._emit(dict(op, wal="op"))
 
     def commit(self) -> None:
         if self._open is None:
             return
-        self._emit({"wal": "commit",
-                    "batch": self.folded_batches + len(self.batches),
-                    "n": len(self._open)})
-        self.batches.append(self._open)
-        self._open = None
-        self.stats["wal_commits"] += 1
-        self._commits_since_flush += 1
-        if self._commits_since_flush >= self.commit_every:
-            self._flush()
-        if self.compact_every and len(self.batches) >= self.compact_every:
-            self.compact()
+        from ..obs.trace import span as _span
+        with _span("wal.commit"):
+            self._emit({"wal": "commit",
+                        "batch": self.folded_batches + len(self.batches),
+                        "n": len(self._open)})
+            self.batches.append(self._open)
+            self._open = None
+            self.stats["wal_commits"] += 1
+            self._commits_since_flush += 1
+            if self._commits_since_flush >= self.commit_every:
+                self._flush()
+            if self.compact_every and len(self.batches) >= self.compact_every:
+                self.compact()
 
     def _emit(self, rec: dict) -> None:
         if self._fh is None:
@@ -330,32 +336,34 @@ class CycleWAL:
             self.folded_ops += sum(len(b) for b in self.batches)
             self.batches = []
             return n
-        n = len(self.batches)
-        self.folded_batches += n
-        self.folded_ops += sum(len(b) for b in self.batches)
-        self.batches = []
-        tmp = self.path + ".compact"
-        with open(tmp, "w", encoding="utf-8") as out:
-            out.write(json.dumps(
-                {"wal": "checkpoint",
-                 "folded_batches": self.folded_batches,
-                 "folded_ops": self.folded_ops}, sort_keys=True) + "\n")
-            for op in (self._open or ()):
-                out.write(json.dumps(dict(op, wal="op"),
-                                     sort_keys=True) + "\n")
-            out.flush()
-            os.fsync(out.fileno())
-        self._fh.flush()
-        self._fh.close()
-        if _chaos.ACTIVE is not None:
-            # crash here leaves the old journal intact plus a stray
-            # .compact temp file: recovery reads the uncompacted log
-            _chaos.ACTIVE.crashpoint("wal.compact")
-        os.replace(tmp, self.path)
-        self._fh = open(self.path, "a", encoding="utf-8")
-        self._commits_since_flush = 0
-        self.stats["wal_compactions"] += 1
-        return n
+        from ..obs.trace import span as _span
+        with _span("wal.compact"):
+            n = len(self.batches)
+            self.folded_batches += n
+            self.folded_ops += sum(len(b) for b in self.batches)
+            self.batches = []
+            tmp = self.path + ".compact"
+            with open(tmp, "w", encoding="utf-8") as out:
+                out.write(json.dumps(
+                    {"wal": "checkpoint",
+                     "folded_batches": self.folded_batches,
+                     "folded_ops": self.folded_ops}, sort_keys=True) + "\n")
+                for op in (self._open or ()):
+                    out.write(json.dumps(dict(op, wal="op"),
+                                         sort_keys=True) + "\n")
+                out.flush()
+                os.fsync(out.fileno())
+            self._fh.flush()
+            self._fh.close()
+            if _chaos.ACTIVE is not None:
+                # crash here leaves the old journal intact plus a stray
+                # .compact temp file: recovery reads the uncompacted log
+                _chaos.ACTIVE.crashpoint("wal.compact")
+            os.replace(tmp, self.path)
+            self._fh = open(self.path, "a", encoding="utf-8")
+            self._commits_since_flush = 0
+            self.stats["wal_compactions"] += 1
+            return n
 
     def close(self) -> None:
         if self._fh is not None:

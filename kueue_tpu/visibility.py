@@ -200,6 +200,36 @@ class VisibilityServer:
                     self.end_headers()
                     self.wfile.write(payload)
                     return
+                if self.path.split("?")[0] == "/debug/flightrecorder":
+                    # flight-recorder dump (the pkg/debugger analog,
+                    # live over HTTP instead of SIGUSR2)
+                    driver = service.driver
+                    body = {"error": "no obs plane"}
+                    if hasattr(driver, "obs"):
+                        body = driver.obs.flight.dump()
+                        body["events"] = driver.obs.events.report()
+                        body["tracing"] = driver.obs.tracing
+                    payload = json.dumps(body).encode()
+                    self.send_response(200)
+                    self.send_header("Content-Type", "application/json")
+                    self.send_header("Content-Length", str(len(payload)))
+                    self.end_headers()
+                    self.wfile.write(payload)
+                    return
+                if self.path.split("?")[0] == "/debug/spans":
+                    # Chrome trace-event JSON: open in Perfetto /
+                    # chrome://tracing next to jax.profiler traces
+                    driver = service.driver
+                    body = {"traceEvents": []}
+                    if hasattr(driver, "obs"):
+                        body = driver.obs.spans_chrome_trace()
+                    payload = json.dumps(body).encode()
+                    self.send_response(200)
+                    self.send_header("Content-Type", "application/json")
+                    self.send_header("Content-Length", str(len(payload)))
+                    self.end_headers()
+                    self.wfile.write(payload)
+                    return
                 parts = [p for p in self.path.split("?")[0].split("/") if p]
                 # /apis/visibility/v1beta1/clusterqueues/{cq}/pendingworkloads
                 # /apis/visibility/v1beta1/namespaces/{ns}/localqueues/{lq}/pendingworkloads

@@ -144,6 +144,7 @@ def _result(ck, control, faulted, inj, mode, extra=None):
         "state_digest": {"control": global_digest(control),
                          "faulted": global_digest(faulted)},
         "chaos": chaos_report(injector=inj),
+        "obs": faulted.manager.obs.report(),
     }
     out.update(extra or {})
     return out
@@ -335,6 +336,10 @@ def main() -> int:
             v.get("double_admissions", 0) for v in scenarios.values()),
         "value": stable,
         "elapsed_s": round(time.perf_counter() - t_start, 2),
+        # r16+: the telemetry plane rides every soak — the first
+        # scenario's manager-side obs block stands for the run
+        "obs": next((v["obs"] for v in scenarios.values()
+                     if "obs" in v), None),
         "hard_paths_exercised": [
             "fed.partition between nomination and winner selection",
             "half-open try_reconnect + reconcile_rejoined stale-mirror GC",

@@ -467,6 +467,7 @@ def run_burst_path(args, backend: str) -> dict:
         "burst_stats": dict(d._burst_solver.stats),
         "boundary_pipeline": burst_boundary_report(d._burst_solver.stats),
         "solver_stats": dict(d.scheduler.solver.stats),
+        "obs": d.obs.report(),
     }
     if budget_s:
         out["budget_s"] = budget_s
@@ -562,6 +563,7 @@ def run_fs_path(args, use_device: bool) -> dict:
         "skipped": skipped_total,
         "workloads": total,
         "fs_stats": dict(d.scheduler.fs_stats),
+        "obs": d.obs.report(),
     }
     if solver is not None:
         out["solver_stats"] = dict(solver.stats)
@@ -645,6 +647,7 @@ def run_path(args, use_device: bool) -> dict:
         "workloads": total,
         "cycles_run": len(cycle_times),
         "completed": completed,
+        "obs": d.obs.report(),
     }
     if budget_s:
         out["budget_s"] = budget_s
@@ -1117,7 +1120,8 @@ def main():
                 tail["mesh"]["shard_imbalance"] = e["imbalance"]
                 break
     for r in results:
-        tail[r["path"]] = {k: v for k, v in r.items() if k != "path"}
+        tail[r["path"]] = {k: v for k, v in r.items()
+                           if k not in ("path", "obs")}
     piped_r = next((r for r in results
                     if r["path"].startswith("burst-")
                     and "-serial" not in r["path"]
@@ -1231,6 +1235,12 @@ def main():
         tail["hard_paths_exercised"] = all(
             r["preempted"] > 0 and r["skipped"] > 0 for r in results
             if r.get("completed", True))
+    # r16+: the telemetry plane rides every soak — stamp the headline
+    # arm's obs block (validate_artifacts requires it from r16 on)
+    obs_by_path = {r["path"]: r["obs"] for r in results if r.get("obs")}
+    if obs_by_path:
+        tail["obs"] = obs_by_path.get(tail.get("best_solver_path"),
+                                      next(iter(obs_by_path.values())))
     print(json.dumps(tail))
     if args.out:
         with open(args.out, "w") as f:

@@ -1,0 +1,306 @@
+"""OBS soak: prove the telemetry plane is free, honest, and dumpable.
+
+Runs the host-path drain scenario (oversubscribed cohorts, WAL
+attached) through two arms on identically-built drivers, interleaved
+at *cycle* granularity — cycle k runs on the untraced driver and the
+traced driver back to back (order alternating per cycle), so every
+traced sample has a time-adjacent untraced partner and machine drift
+(frequency scaling, noisy neighbors) cancels out of the A/B — and
+publishes:
+
+  decisions  — per-cycle decision digests and the final admitted set
+               must be bit-identical between the arms and across every
+               rep (tracing may not change a single decision);
+  overhead   — traced vs untraced per-cycle wall p50 over the
+               min-across-reps per cycle index (interference only ever
+               adds time); the ratio must hold the <= 5% guarantee
+               validate_artifacts enforces;
+  spans      — the traced arm's per-phase roster must cover every
+               host hot-path phase (cycle, cycle.snapshot,
+               cycle.nominate, cycle.admit, wal.append, wal.commit);
+  dumps      — a programmatic flight-recorder dump whose digests match
+               the recorded cycles, a SIGUSR2 state dump carrying the
+               obs sections, and a non-empty Chrome trace
+               (/debug/spans food, opens in Perfetto).
+
+Usage:
+    python scripts/obs_soak.py [--cycles 16] [--reps 5] [--quick]
+        [--out OBS_r16.json]
+"""
+
+from __future__ import annotations
+
+import argparse
+import gc
+import io
+import json
+import os
+import signal
+import statistics
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from kueue_tpu.api.types import (
+    ClusterQueue,
+    FlavorQuotas,
+    LocalQueue,
+    PodSet,
+    PreemptionPolicy,
+    QueueingStrategy,
+    ResourceFlavor,
+    ResourceGroup,
+    ResourceQuota,
+    Workload,
+)
+from kueue_tpu.controller.driver import Driver
+from kueue_tpu.debugger import Dumper
+from kueue_tpu.obs import trace as obs_trace
+from kueue_tpu.obs.flight import decision_digest
+from kueue_tpu.utils.journal import CycleWAL
+
+
+class VirtualClock:
+    def __init__(self, t=1000.0):
+        self.t = t
+
+    def __call__(self):
+        return self.t
+
+
+def log(msg: str) -> None:
+    print(msg, file=sys.stderr, flush=True)
+
+
+def build(n_cohorts: int, cqs: int, per_lq: int) -> tuple[Driver, VirtualClock]:
+    """Fresh driver per arm: oversubscribed drain (quota-bound
+    admissions against a deep backlog), runtime-driven finishes,
+    BEST_EFFORT_FIFO — the chaos-soak shape, host path so every
+    classical phase appears in the roster."""
+    clock = VirtualClock()
+    d = Driver(clock=clock, use_device_solver=False)
+    d.attach_wal(CycleWAL())
+    d.apply_resource_flavor(ResourceFlavor(name="default"))
+    n = 0
+    for c in range(n_cohorts):
+        for q in range(cqs):
+            name = f"cq-{c}-{q}"
+            d.apply_cluster_queue(ClusterQueue(
+                name=name, cohort=f"co-{c}",
+                queueing_strategy=QueueingStrategy.BEST_EFFORT_FIFO,
+                preemption=PreemptionPolicy(),
+                resource_groups=[ResourceGroup(
+                    covered_resources=["cpu"],
+                    flavors=[FlavorQuotas(name="default", resources={
+                        "cpu": ResourceQuota(nominal=4000)})])]))
+            d.apply_local_queue(LocalQueue(name=f"lq-{c}-{q}",
+                                           cluster_queue=name))
+            for i in range(per_lq):
+                n += 1
+                d.create_workload(Workload(
+                    name=f"w-{c}-{q}-{i}",
+                    queue_name=f"lq-{c}-{q}", priority=(i % 3) * 10,
+                    creation_time=float(n),
+                    pod_sets=[PodSet(name="main", count=1,
+                                     requests={"cpu": 1500})]))
+    return d, clock
+
+
+def _drive(d, clock, out, c: int, runtime: int) -> float:
+    """One harness cycle on one driver: tick, schedule (timed), finish
+    admissions whose modeled runtime elapsed (untimed)."""
+    clock.t += 1.0
+    t0 = time.perf_counter()
+    stats = d.schedule_once()
+    wall = time.perf_counter() - t0
+    out.append(stats)
+    if runtime > 0 and c - runtime >= 0:
+        for key in out[c - runtime].admitted:
+            wl = d.workloads.get(key)
+            if wl is not None and wl.has_quota_reservation:
+                d.finish_workload(key)
+    return wall
+
+
+def run_pair(cycles: int, runtime: int, shape: tuple[int, int, int]):
+    """One rep: an untraced and a traced driver advanced in lockstep,
+    cycle k on both back to back (order alternating per cycle).  The
+    process-global tracer is installed around the traced driver's
+    cycle only — its finishes included — and cleared for the untraced
+    one, so the untraced arm never pays a single span."""
+    obs_trace.clear()
+    du, cu = build(*shape)
+    dt, ct = build(*shape)
+    tracer = dt.obs.enable_tracing()
+    obs_trace.clear()
+    outs = {"untraced": [], "traced": []}
+    walls = {"untraced": [], "traced": []}
+    arms = {"untraced": (du, cu, None), "traced": (dt, ct, tracer)}
+    order = ("untraced", "traced")
+    gc.collect()
+    gc.disable()   # collector pauses land on whichever arm is running
+    try:
+        for c in range(cycles):
+            for name in (order if c % 2 == 0 else order[::-1]):
+                d, clock, tr = arms[name]
+                obs_trace.install(tr)   # None = off for the untraced arm
+                walls[name].append(_drive(d, clock, outs[name], c,
+                                          runtime))
+            obs_trace.clear()
+    finally:
+        gc.enable()
+    return {
+        "digests": {n: [decision_digest(s) for s in outs[n]]
+                    for n in outs},
+        "walls": walls,
+        "admitted": {n: sorted(arms[n][0].admitted_keys()) for n in outs},
+        "traced_driver": dt,
+        "tracer": tracer,
+    }
+
+
+def sigusr2_dump(d) -> bool:
+    """Fire a real SIGUSR2 at ourselves through debugger.Dumper and
+    check the dump carries the obs sections."""
+    buf = io.StringIO()
+    old = signal.getsignal(signal.SIGUSR2)
+    try:
+        Dumper(d, out=buf).listen_for_signal()
+        os.kill(os.getpid(), signal.SIGUSR2)
+    finally:
+        signal.signal(signal.SIGUSR2, old)
+    text = buf.getvalue()
+    return bool(text) and "flight" in text
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser(description=__doc__)
+    ap.add_argument("--cycles", type=int, default=16)
+    ap.add_argument("--runtime", type=int, default=2,
+                    help="modeled runtime (cycles) before finish")
+    ap.add_argument("--reps", type=int, default=12,
+                    help="lockstep untraced+traced rep pairs")
+    ap.add_argument("--cohorts", type=int, default=4)
+    ap.add_argument("--cqs-per-cohort", type=int, default=4)
+    ap.add_argument("--per-lq", type=int, default=24)
+    ap.add_argument("--quick", action="store_true",
+                    help="fewer reps for a seconds-level pass")
+    ap.add_argument("--out", default=os.path.join(
+        os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+        "OBS_r16.json"))
+    args = ap.parse_args()
+
+    reps = 8 if args.quick else args.reps
+    shape = (args.cohorts, args.cqs_per_cohort, args.per_lq)
+    t_start = time.perf_counter()
+    log(f"obs soak: cycles={args.cycles} reps={reps} shape={shape} "
+        f"(cycle-interleaved untraced/traced)")
+
+    # warmup rep, discarded: first-touch costs (imports, caches,
+    # allocator) must not land on either side of the A/B
+    run_pair(args.cycles, args.runtime, shape)
+    gc.collect()
+
+    pairs = []
+    for rep in range(reps):
+        p = run_pair(args.cycles, args.runtime, shape)
+        pairs.append(p)
+        log(f"  rep {rep} admitted={len(p['admitted']['traced'])} "
+            f"untraced_p50="
+            f"{statistics.median(p['walls']['untraced']) * 1e3:.3f}ms "
+            f"traced_p50="
+            f"{statistics.median(p['walls']['traced']) * 1e3:.3f}ms")
+        gc.collect()
+
+    # --- bit-identity between arms and across every rep --------------
+    ref_digests = pairs[0]["digests"]["untraced"]
+    ref_admitted = pairs[0]["admitted"]["untraced"]
+    decisions_identical = all(
+        p["digests"][arm] == ref_digests
+        and p["admitted"][arm] == ref_admitted
+        for p in pairs for arm in ("untraced", "traced"))
+    log(f"decisions {'bit-identical' if decisions_identical else 'DIVERGED'}"
+        f" across {2 * reps} runs")
+
+    # --- overhead: per-cycle wall p50 over min-across-reps -----------
+    # cycle k is the same work in every rep; the min across reps is
+    # the interference-free estimate of that cycle (noise only ever
+    # adds time), and the cycle-interleaved arms see the same drift
+    pool = {arm: [min(p["walls"][arm][k] for p in pairs)
+                  for k in range(args.cycles)]
+            for arm in ("untraced", "traced")}
+    traced_p50_ms = statistics.median(pool["traced"]) * 1e3
+    untraced_p50_ms = statistics.median(pool["untraced"]) * 1e3
+    ratio = traced_p50_ms / untraced_p50_ms
+    log(f"overhead: traced_p50={traced_p50_ms:.4f}ms "
+        f"untraced_p50={untraced_p50_ms:.4f}ms ratio={ratio:.4f}")
+
+    # --- roster + dumps from the last rep's traced driver ------------
+    last = pairs[-1]
+    d = last["traced_driver"]
+    obs_trace.install(last["tracer"])   # dumps read the live tracer
+    roster = last["tracer"].roster()
+    missing = [p for p in obs_trace.HOT_PATH_PHASES
+               if p in ("cycle", "cycle.snapshot", "cycle.nominate",
+                        "cycle.order", "cycle.admit", "wal.append",
+                        "wal.commit") and p not in roster]
+
+    dump = d.obs.flight.dump()
+    traced_digests = last["digests"]["traced"]
+    flight_ok = (dump["buffered"] == len(dump["cycles"])
+                 and [c["digest"] for c in dump["cycles"]]
+                 == traced_digests[-dump["buffered"]:]
+                 # empty-head cycles open no spans; every deciding
+                 # cycle must carry its span trail
+                 and all(c["spans"] for c in dump["cycles"]
+                         if c["admitted"] or c["preempting"]))
+    sig_ok = sigusr2_dump(d)
+    chrome = d.obs.spans_chrome_trace()
+    obs_block = d.obs.report()
+    spans_out = {p: {"count": row["count"],
+                     "p50_ms": round(row["p50_ms"], 4),
+                     "p99_ms": round(row["p99_ms"], 4),
+                     "total_s": round(row["total_s"], 6)}
+                 for p, row in roster.items()}
+    obs_trace.clear()
+    log(f"roster: {sorted(roster)}; flight_ok={flight_ok} "
+        f"sigusr2_ok={sig_ok} chrome_events={len(chrome['traceEvents'])}")
+
+    tail = {
+        "metric": "obs_tracing_overhead_ratio",
+        "unit": "traced / untraced per-cycle wall p50 (drift-fair A/B)",
+        "cqs": args.cohorts * args.cqs_per_cohort,
+        "cycles": args.cycles,
+        "reps": reps,
+        "quick": bool(args.quick),
+        "control": {"arm": "untraced", "interleaved": True,
+                    "reps": reps,
+                    "cycle_wall_p50_ms": untraced_p50_ms},
+        "decisions_identical": decisions_identical,
+        "admitted_total": len(ref_admitted),
+        "overhead": {"traced_p50_ms": traced_p50_ms,
+                     "untraced_p50_ms": untraced_p50_ms,
+                     "ratio": ratio},
+        "spans": spans_out,
+        "spans_missing_host_phases": missing,
+        "dumps": {"flightrecorder_ok": flight_ok,
+                  "sigusr2_ok": sig_ok,
+                  "chrome_trace_events": len(chrome["traceEvents"])},
+        "obs": obs_block,
+        "value": ratio,
+        "elapsed_s": round(time.perf_counter() - t_start, 2),
+    }
+    ok = (decisions_identical and ratio <= 1.05 and not missing
+          and flight_ok and sig_ok and chrome["traceEvents"])
+    print(json.dumps({k: tail[k] for k in
+                      ("metric", "value", "decisions_identical")}))
+    with open(args.out, "w") as f:
+        json.dump(tail, f, indent=1)
+        f.write("\n")
+    log(f"wrote {args.out}")
+    return 0 if ok else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -187,6 +187,7 @@ def probe(cfg: dict, rate: float, shards: int, *, seed: int,
     rc = LocalWorkerClient(d) if remote else None
     r = run_open_loop(d, clock, stream, oc, remote_client=rc)
     r.rate_per_s = rate
+    r.obs = d.obs.report()
     gc.collect()
     return r
 
@@ -435,6 +436,9 @@ def main() -> int:
         "snapshot_counters": snapshot_counters,
         "storm_probe": storm_block,
         "remote_probe": remote_block,
+        # r16+: the telemetry plane rides every soak — the replay-rate
+        # serial probe's obs block stands for the headline arm
+        "obs": live.obs,
         "value": saturation["serial"]["sustainable_rate_per_s"],
         "wall_s_total": round(time.perf_counter() - t_start, 1),
     }

@@ -25,12 +25,21 @@ unreadable number.  Checks are tiered:
                      ``snapshot_counters`` host-cost block.
   NORTHSTAR_* /
   MULTICHIP_r08+   — additionally: ``metric`` + numeric ``value``.
-  LINT_*           — additionally: the five named analysis passes, a
+  LINT_*           — additionally: the named analysis passes (a prefix
+                     of the canonical roster; metrics-doc joined at
+                     r16), a
                      ``findings`` list whose length equals ``value``,
                      ``ok`` consistent with findings/stale entries, a
                      strictly-shrinking baseline
                      (``baseline_entries`` < ``first_full_run_findings``),
                      and a sub-10s ``elapsed_s`` (the lint is tier-1).
+  OBS_*            — additionally: an interleaved untraced ``control``
+                     arm, ``decisions_identical`` true, an ``overhead``
+                     block whose ratio stays <= 1.05, a ``spans``
+                     roster covering every host hot-path phase, working
+                     ``dumps`` surfaces, and the ``obs`` block itself.
+                     NORTHSTAR/TRAFFIC/FED artifacts from r16 on must
+                     also carry an ``obs`` block.
   MULTICHIP_r10+   — additionally: at least one ``crossover`` block
                      (top level or per-``runs`` entry) whose ``curve``
                      lists one entry per shard arm with int ``shards``,
@@ -367,21 +376,29 @@ def _check_traffic(d, path, out):
 
 
 _LINT_PASSES = ("purity", "dtype", "wal-order", "chaos-sites",
-                "env-flags")
+                "env-flags", "metrics-doc")
+#: Passes that must appear in every LINT_* artifact regardless of age.
+#: Later rounds append passes (r16 added metrics-doc), so the check is
+#: "a prefix of the canonical order" rather than exact equality —
+#: LINT_r14 stays valid while new artifacts must carry the full roster.
+_LINT_PASSES_REQUIRED = _LINT_PASSES[:5]
 
 
 def _check_lint(d, path, out):
     """LINT_* invariant-lint artifacts (scripts/lint_invariants.py
-    --artifact): all five passes ran, the finding count matches the
-    headline 'value', the ok verdict matches the findings/stale state,
-    the baseline only ever shrinks, and the run stayed tier-1 fast."""
+    --artifact): the named passes ran in canonical order, the finding
+    count matches the headline 'value', the ok verdict matches the
+    findings/stale state, the baseline only ever shrinks, and the run
+    stayed tier-1 fast."""
     passes = d.get("passes")
     names = [p.get("name") for p in passes] \
         if isinstance(passes, list) \
         and all(isinstance(p, dict) for p in passes) else None
-    if names != list(_LINT_PASSES):
-        _err(out, path, f"'passes' must name exactly {_LINT_PASSES}, "
-             f"in order (got {names})")
+    if names is None or tuple(names) != _LINT_PASSES[:len(names)] \
+            or len(names) < len(_LINT_PASSES_REQUIRED):
+        _err(out, path, f"'passes' must be a prefix of {_LINT_PASSES} "
+             f"covering at least {_LINT_PASSES_REQUIRED} "
+             f"(got {names})")
     findings = d.get("findings")
     if not isinstance(findings, list):
         _err(out, path, "'findings' must be a list")
@@ -414,6 +431,95 @@ def _check_lint(d, path, out):
     elif el >= 10.0:
         _err(out, path, f"'elapsed_s'={el} breaks the <10s tier-1 "
              "budget")
+
+
+#: Hot-path phases the OBS artifact's span roster must cover — kept in
+#: sync with kueue_tpu/obs/trace.py HOT_PATH_PHASES by tests/test_obs.py
+#: (test_validator_phases_are_a_subset_of_hot_path).
+_OBS_HOST_PHASES = ("cycle", "cycle.snapshot", "cycle.nominate",
+                    "cycle.admit", "wal.append", "wal.commit")
+
+
+def _check_obs_block(obs, path, out, where="obs"):
+    """The ``obs`` block every r16+ soak artifact carries: event-stream
+    counts, flight-recorder totals, and the tracing flag."""
+    if not isinstance(obs, dict):
+        _err(out, path, f"'{where}' must be an object")
+        return
+    ev = obs.get("events")
+    if not isinstance(ev, dict) or not isinstance(ev.get("counts"), dict) \
+            or not isinstance(ev.get("total"), int) \
+            or not isinstance(ev.get("dropped"), int):
+        _err(out, path, f"'{where}.events' needs counts/total/dropped")
+    elif sum(ev["counts"].values()) != ev["total"]:
+        _err(out, path, f"'{where}.events': counts sum "
+             f"{sum(ev['counts'].values())} != total {ev['total']}")
+    fl = obs.get("flight")
+    if not isinstance(fl, dict) \
+            or not isinstance(fl.get("recorded_total"), int) \
+            or not isinstance(fl.get("buffered"), int):
+        _err(out, path, f"'{where}.flight' needs recorded_total/buffered")
+    elif fl["buffered"] > fl["recorded_total"]:
+        _err(out, path, f"'{where}.flight': buffered exceeds "
+             "recorded_total")
+    if not isinstance(obs.get("tracing"), bool):
+        _err(out, path, f"'{where}' missing bool 'tracing'")
+
+
+def _check_obs(d, path, out):
+    """OBS_* telemetry artifacts (scripts/obs_soak.py): a traced and an
+    interleaved untraced arm over the same scenario, bit-identical
+    decision digests, <= 5% traced p50 overhead, a span roster covering
+    every host hot-path phase, and working dump surfaces."""
+    control = d.get("control")
+    if not isinstance(control, dict) \
+            or control.get("interleaved") is not True:
+        _err(out, path, "'control' must be an object with "
+             "interleaved=true (same-box drift-fair untraced arm)")
+    if d.get("decisions_identical") is not True:
+        _err(out, path, "'decisions_identical' must be true: tracing "
+             "may not change a single decision")
+    ov = d.get("overhead")
+    if not isinstance(ov, dict) \
+            or not isinstance(ov.get("traced_p50_ms"), (int, float)) \
+            or not isinstance(ov.get("untraced_p50_ms"), (int, float)) \
+            or not isinstance(ov.get("ratio"), (int, float)):
+        _err(out, path, "'overhead' needs traced_p50_ms / "
+             "untraced_p50_ms / ratio")
+    else:
+        if ov["untraced_p50_ms"] > 0 and abs(
+                ov["ratio"] - ov["traced_p50_ms"] / ov["untraced_p50_ms"]
+        ) > 1e-6:
+            _err(out, path, "'overhead.ratio' does not equal "
+                 "traced_p50_ms / untraced_p50_ms")
+        if ov["ratio"] > 1.05:
+            _err(out, path, f"'overhead.ratio'={ov['ratio']:.4f} breaks "
+                 "the <=5% tracing-overhead guarantee")
+    spans = d.get("spans")
+    if not isinstance(spans, dict):
+        _err(out, path, "missing 'spans' roster object")
+    else:
+        missing = [p for p in _OBS_HOST_PHASES if p not in spans]
+        if missing:
+            _err(out, path, f"span roster missing hot-path phases "
+                 f"{missing}")
+        for phase, row in spans.items():
+            if not isinstance(row, dict) \
+                    or not isinstance(row.get("count"), int) \
+                    or not isinstance(row.get("p50_ms"), (int, float)) \
+                    or not isinstance(row.get("p99_ms"), (int, float)):
+                _err(out, path, f"span roster row '{phase}' needs "
+                     "count/p50_ms/p99_ms")
+    dumps = d.get("dumps")
+    if not isinstance(dumps, dict) \
+            or dumps.get("flightrecorder_ok") is not True \
+            or dumps.get("sigusr2_ok") is not True \
+            or dumps.get("chrome_trace_events", 0) <= 0:
+        _err(out, path, "'dumps' must prove flightrecorder_ok, "
+             "sigusr2_ok, and a non-empty chrome trace")
+    _check_obs_block(d.get("obs"), path, out)
+    if not isinstance(d.get("elapsed_s"), (int, float)):
+        _err(out, path, "missing numeric 'elapsed_s'")
 
 
 def _check_fed(d, path, out):
@@ -472,7 +578,7 @@ def _check_fed(d, path, out):
 # at top level); older BENCH_/MULTICHIP_r01-05 wrappers predate it and
 # only get the common checks
 _STRICT_PREFIXES = ("NORTHSTAR_", "CHAOS_", "TRAFFIC_", "SCALE_",
-                    "LINT_", "FED_")
+                    "LINT_", "FED_", "OBS_")
 
 
 def validate(path: str) -> list[str]:
@@ -505,6 +611,19 @@ def validate(path: str) -> list[str]:
     # federation-soak record even if the file was renamed
     if base.startswith("FED_") or "double_admissions_total" in d:
         _check_fed(d, path, out)
+    # by name or by shape: an overhead A/B block marks a telemetry
+    # artifact even if the file was renamed
+    if base.startswith("OBS_") or "overhead" in d:
+        _check_obs(d, path, out)
+    # from r16 on, every NORTHSTAR/TRAFFIC/FED soak artifact must carry
+    # the obs block (the telemetry plane rides every soak)
+    rnd = re.match(r"(?:NORTHSTAR|TRAFFIC|FED)_R(\d+)", base)
+    if rnd and int(rnd.group(1)) >= 16:
+        if "obs" not in d:
+            _err(out, path, f"{base.split('_')[0]}_r16+ artifacts must "
+                 "carry an 'obs' block")
+        else:
+            _check_obs_block(d["obs"], path, out)
     m = re.match(r"MULTICHIP_R(\d+)", base)
     if base.startswith(_STRICT_PREFIXES) or (m and int(m.group(1)) >= 8):
         _check_metric_value(d, path, out)

@@ -93,3 +93,20 @@ def test_chaos_soak_quick(tmp_path):
     d = _run_quick("chaos_soak.py", out)
     assert d["all_stable"] is True
     assert _validate(out) == []
+
+
+def test_obs_soak_quick(tmp_path):
+    """The telemetry plane end to end at smoke scale: interleaved
+    traced/untraced arms on identically-built drivers, bit-identical
+    decisions, a covering span roster, and working dump surfaces."""
+    out = str(tmp_path / "OBS_r99.json")
+    d = _run_quick("obs_soak.py", out)
+    assert d["quick"] is True
+    assert d["decisions_identical"] is True
+    assert d["overhead"]["ratio"] <= 1.05
+    assert d["spans_missing_host_phases"] == []
+    assert d["dumps"]["flightrecorder_ok"] is True
+    assert d["dumps"]["sigusr2_ok"] is True
+    assert d["dumps"]["chrome_trace_events"] > 0
+    assert d["control"]["interleaved"] is True
+    assert _validate(out) == []

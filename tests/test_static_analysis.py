@@ -421,7 +421,8 @@ def test_cli_json_output_and_budget():
     report = json.loads(proc.stdout)
     assert report["ok"] is True
     assert [p["name"] for p in report["passes"]] == [
-        "purity", "dtype", "wal-order", "chaos-sites", "env-flags"]
+        "purity", "dtype", "wal-order", "chaos-sites", "env-flags",
+        "metrics-doc"]
     assert report["findings"] == []
     assert report["elapsed_s"] < 10.0, "the lint must stay tier-1 fast"
 
