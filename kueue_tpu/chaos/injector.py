@@ -56,6 +56,16 @@ site                            effect at the call point
                                 snapshot is taken but serialization has not
                                 happened (a re-dump after recovery must be
                                 identical — dumping never mutates the ring)
+``svc.ingest``                  crash after a submission's accept record is
+                                journaled durably but before it enters the
+                                in-memory ingest queue (recovery re-enqueues
+                                it from the ingest journal)
+``svc.cycle``                   crash at a service-step boundary, before the
+                                ingest drain (pending ingest entries and the
+                                WAL tail survive on disk)
+``svc.shutdown``                crash mid graceful drain: in-flight cycles
+                                finished but the final WAL/ingest-journal
+                                flush has not happened
 ==============================  =============================================
 
 ``KUEUE_TPU_CHAOS_SEED`` seeds the process-default injector (see

@@ -312,7 +312,9 @@ class Driver:
     # Workload lifecycle (reference core/workload_controller.go)
     # ------------------------------------------------------------------
 
-    def create_workload(self, wl: Workload) -> None:
+    def _prepare_workload(self, wl: Workload) -> None:
+        """Defaulting + validation + store write — everything
+        ``create_workload`` does short of queueing."""
         webhooks.default_workload(wl)
         summary = self.scheduler.limit_range_summaries.get(wl.namespace)
         if summary is not None:
@@ -324,8 +326,25 @@ class Driver:
         if wl.creation_time == 0.0:
             wl.creation_time = self.clock()
         self.workloads[wl.key] = wl
+
+    def create_workload(self, wl: Workload) -> None:
+        self._prepare_workload(wl)
         self.queues.add_or_update_workload(wl)
         self.metrics.pending_inc(wl)
+
+    def ingest_workloads(self, wls) -> int:
+        """Bulk create for the serving ingest drain: prepare every
+        workload, then queue the whole batch under one manager lock
+        acquisition (queue.Manager.add_workloads) instead of one per
+        workload.  Same per-workload semantics as ``create_workload``;
+        returns the batch size."""
+        batch = list(wls)
+        for wl in batch:
+            self._prepare_workload(wl)
+        self.queues.add_workloads(batch)
+        for wl in batch:
+            self.metrics.pending_inc(wl)
+        return len(batch)
 
     def restore_workload(self, wl: Workload) -> None:
         """Crash-recovery replay (SURVEY §5.4): rebuild in-memory state

@@ -156,6 +156,17 @@ ENV_FLAGS: dict[str, EnvFlag] = {f.name: f for f in (
             "Event-stream ring capacity (admit/evict/preempt/...)."),
     EnvFlag("KUEUE_TPU_FLIGHT_CYCLES", "256", "int",
             "Flight-recorder ring capacity, in cycles."),
+    EnvFlag("KUEUE_TPU_SVC_HIGH_WATER", "4096", "int",
+            "Serving ingest-queue depth past which backpressure "
+            "rejects/sheds submissions."),
+    EnvFlag("KUEUE_TPU_SVC_SLO_P99_S", "8.0", "str",
+            "Serving p99 admission-latency SLO target, seconds."),
+    EnvFlag("KUEUE_TPU_SVC_DRAIN_TIMEOUT_S", "30", "int",
+            "Graceful-drain deadline after SIGTERM, wall seconds."),
+    EnvFlag("KUEUE_TPU_SVC_INGEST_JOURNAL", "", "path",
+            "Durable ingest-journal path; empty = in-memory only."),
+    EnvFlag("KUEUE_TPU_SVC_SEED", "1709", "int",
+            "Seed for the serving soak."),
 )}
 
 

@@ -11,6 +11,7 @@ series names stay wire-compatible.
 from __future__ import annotations
 
 import math
+import threading
 from collections import defaultdict
 from dataclasses import dataclass, field
 
@@ -26,6 +27,8 @@ WAIT_BUCKETS = exponential_buckets(1, 2, 14)
 # requeue-storm sizes (workloads unparked per cohort wakeup)
 LATENCY_BUCKETS = exponential_buckets(0.25, 2, 18)
 STORM_BUCKETS = exponential_buckets(1, 2, 16)
+# serving admission latency is wall-clock (accept→admit): 1ms .. ~9min
+SVC_LATENCY_BUCKETS = exponential_buckets(0.001, 2, 20)
 
 
 @dataclass
@@ -61,28 +64,41 @@ class Histogram:
 
 
 class Registry:
+    """Thread-safe: the serving path (serving/service.py) updates
+    counters and gauges from submitter threads while the HTTP
+    ``/metrics`` handler renders from another, so every mutation and
+    the full render hold ``_lock``.  An RLock, and uncontended in the
+    single-threaded batch harnesses (a few ns per op); the tracer's
+    direct histogram inserts (obs/trace.py) take the same lock only on
+    the first observation of a phase."""
+
     def __init__(self):
         self.counters: dict[tuple, float] = defaultdict(float)
         self.gauges: dict[tuple, float] = defaultdict(float)
         self.histograms: dict[tuple, Histogram] = {}
+        self._lock = threading.RLock()
 
     # -- generic --
 
     def inc(self, name: str, labels: tuple = (), value: float = 1.0) -> None:
-        self.counters[(name, *labels)] += value
+        with self._lock:
+            self.counters[(name, *labels)] += value
 
     def set_gauge(self, name: str, labels: tuple, value: float) -> None:
-        self.gauges[(name, *labels)] = value
+        with self._lock:
+            self.gauges[(name, *labels)] = value
 
     def add_gauge(self, name: str, labels: tuple, delta: float) -> None:
-        self.gauges[(name, *labels)] += delta
+        with self._lock:
+            self.gauges[(name, *labels)] += delta
 
     def observe(self, name: str, labels: tuple, value: float,
                 buckets: list[float] = ATTEMPT_BUCKETS) -> None:
         key = (name, *labels)
-        if key not in self.histograms:
-            self.histograms[key] = Histogram(buckets=buckets)
-        self.histograms[key].observe(value)
+        with self._lock:
+            if key not in self.histograms:
+                self.histograms[key] = Histogram(buckets=buckets)
+            self.histograms[key].observe(value)
 
     # -- kueue series (reference metrics.go) --
 
@@ -303,6 +319,32 @@ class Registry:
         self.set_gauge("kueue_flight_cycles_recorded", (),
                        float(flight_recorded))
 
+    # -- serving series (serving/service.py: thread-safe ingest +
+    #    adaptive burst window; the only series written from submitter
+    #    threads, which is why the registry carries a lock) --
+
+    def svc_submission(self, result: str) -> None:
+        """One submission outcome: accepted / rejected / duplicate /
+        shed / draining."""
+        self.inc("kueue_svc_submissions_total", (result,))
+
+    def svc_admission_latency(self, seconds: float) -> None:
+        """Wall-clock accept→admit latency of one served workload."""
+        self.observe("kueue_svc_admission_latency_seconds", (), seconds,
+                     SVC_LATENCY_BUCKETS)
+
+    def svc_sample(self, depth: int, high_water: int, burst_k: int,
+                   ewma_rate: float, retry_after_s: float) -> None:
+        """Per-step serving telemetry: ingest depth vs the backpressure
+        high-water mark, the online-chosen burst window, the arrival
+        EWMA, and the current retry-after estimate."""
+        self.set_gauge("kueue_svc_ingest_depth", (), float(depth))
+        self.set_gauge("kueue_svc_ingest_high_water", (), float(high_water))
+        self.set_gauge("kueue_svc_burst_window", (), float(burst_k))
+        self.set_gauge("kueue_svc_arrival_rate_ewma", (), float(ewma_rate))
+        self.set_gauge("kueue_svc_retry_after_seconds", (),
+                       float(retry_after_s))
+
     # -- exposition --
 
     def render(self) -> str:
@@ -311,31 +353,33 @@ class Registry:
         in ``+Inf`` plus ``_sum``/``_count`` for histograms, and escaped
         label values.  Round-trip checked against a strict parser in
         tests/test_obs.py."""
-        families: dict[str, list] = defaultdict(list)
-        for key, val in self.counters.items():
-            families[key[0]].append((key[1:], val))
-        for key, val in self.gauges.items():
-            families[key[0]].append((key[1:], val))
-        for key, h in self.histograms.items():
-            families[key[0]].append((key[1:], h))
-        lines: list[str] = []
-        for name in sorted(families):
-            spec = SERIES.get(name)
-            kind = spec.kind if spec else (
-                "histogram" if isinstance(families[name][0][1], Histogram)
-                else "untyped")
-            help_text = spec.help if spec else name
-            lines.append(f"# HELP {name} {_escape_help(help_text)}")
-            lines.append(f"# TYPE {name} {kind}")
-            for labels, val in sorted(families[name],
-                                      key=lambda kv: kv[0]):
-                if isinstance(val, Histogram):
-                    lines.extend(_render_histogram(name, labels, val))
-                else:
-                    lines.append(
-                        f"{name}{_fmt_labels(name, labels)}"
-                        f" {_fmt_value(val)}")
-        return "\n".join(lines) + "\n"
+        with self._lock:
+            families: dict[str, list] = defaultdict(list)
+            for key, val in self.counters.items():
+                families[key[0]].append((key[1:], val))
+            for key, val in self.gauges.items():
+                families[key[0]].append((key[1:], val))
+            for key, h in self.histograms.items():
+                families[key[0]].append((key[1:], h))
+            lines: list[str] = []
+            for name in sorted(families):
+                spec = SERIES.get(name)
+                kind = spec.kind if spec else (
+                    "histogram"
+                    if isinstance(families[name][0][1], Histogram)
+                    else "untyped")
+                help_text = spec.help if spec else name
+                lines.append(f"# HELP {name} {_escape_help(help_text)}")
+                lines.append(f"# TYPE {name} {kind}")
+                for labels, val in sorted(families[name],
+                                          key=lambda kv: kv[0]):
+                    if isinstance(val, Histogram):
+                        lines.extend(_render_histogram(name, labels, val))
+                    else:
+                        lines.append(
+                            f"{name}{_fmt_labels(name, labels)}"
+                            f" {_fmt_value(val)}")
+            return "\n".join(lines) + "\n"
 
 
 @dataclass(frozen=True)
@@ -503,6 +547,22 @@ _SERIES_DEFS = [
      "Events dropped from the bounded stream after overflow."),
     ("kueue_flight_cycles_recorded", "gauge", (),
      "Cycles recorded by the flight recorder, cumulative."),
+    # serving plane (serving/)
+    ("kueue_svc_submissions_total", "counter", ("result",),
+     "Service submissions by outcome "
+     "(accepted/rejected/duplicate/shed/draining)."),
+    ("kueue_svc_admission_latency_seconds", "histogram", (),
+     "Wall-clock accept-to-admit latency through the service."),
+    ("kueue_svc_ingest_depth", "gauge", (),
+     "Pending submissions in the service ingest queue."),
+    ("kueue_svc_ingest_high_water", "gauge", (),
+     "Configured ingest backpressure high-water mark."),
+    ("kueue_svc_burst_window", "gauge", (),
+     "Burst-window K chosen online for the current service step."),
+    ("kueue_svc_arrival_rate_ewma", "gauge", (),
+     "EWMA of the submission arrival rate, events/s."),
+    ("kueue_svc_retry_after_seconds", "gauge", (),
+     "Current retry-after hint handed to rejected submitters."),
 ]
 
 SERIES: dict[str, Series] = {

@@ -56,6 +56,9 @@ HOT_PATH_PHASES = (
     "wal.commit",       # cycle-boundary commit (group commit included)
     "wal.compact",      # checkpoint + tail rewrite
     "fed.sync",         # one federation reconcile/sync step
+    "svc.cycle",        # one whole service step (drain + K inner cycles)
+    "svc.ingest",       # cycle-boundary drain of the service ingest queue
+    "svc.shutdown",     # graceful-drain epilogue (final WAL/journal flush)
 )
 
 
@@ -198,12 +201,15 @@ class Tracer:
 
     def _hist_for(self, name: str) -> Histogram:
         # same series/key shape Registry.observe would create, the
-        # dict probes amortised away from the per-span path
+        # dict probes amortised away from the per-span path; the
+        # first-insert holds the registry lock so a concurrent
+        # /metrics render never sees the dict resize mid-iteration
         key = ("kueue_span_duration_seconds", name)
-        h = self.registry.histograms.get(key)
-        if h is None:
-            h = Histogram(buckets=SPAN_BUCKETS)
-            self.registry.histograms[key] = h
+        with self.registry._lock:
+            h = self.registry.histograms.get(key)
+            if h is None:
+                h = Histogram(buckets=SPAN_BUCKETS)
+                self.registry.histograms[key] = h
         self._hists[name] = h
         return h
 

@@ -175,6 +175,17 @@ class Manager:
             self._cond.notify_all()
             return True
 
+    def add_workloads(self, wls) -> int:
+        """Bulk add for the serving ingest drain: one lock acquisition
+        for the whole batch (the lock is reentrant, so the per-workload
+        path runs unchanged inside it).  Returns how many queued."""
+        n = 0
+        with self._lock:
+            for wl in wls:
+                if self.add_or_update_workload(wl):
+                    n += 1
+        return n
+
     def _remove_stale_route(self, wl: Workload) -> None:
         old_lq_key = self._wl_route.get(wl.key)
         if old_lq_key is None or old_lq_key == f"{wl.namespace}/{wl.queue_name}":

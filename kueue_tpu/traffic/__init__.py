@@ -20,6 +20,7 @@ from .arrivals import (
 from .runner import (
     OpenLoopConfig,
     OpenLoopResult,
+    RateEWMA,
     find_sustainable_rate,
     run_open_loop,
 )
@@ -34,6 +35,7 @@ __all__ = [
     "TrafficSpec",
     "OpenLoopConfig",
     "OpenLoopResult",
+    "RateEWMA",
     "find_sustainable_rate",
     "run_open_loop",
 ]

@@ -75,6 +75,38 @@ def _pctile(xs: list, q: float) -> float:
     return s[min(len(s) - 1, max(0, math.ceil(q * len(s)) - 1))]
 
 
+class RateEWMA:
+    """Exponentially-weighted arrival-rate estimator (events/s).
+
+    The admission service (serving/service.py) updates it once per
+    service step with the count of arrivals observed over that step's
+    ``dt`` and reads ``rate_per_s`` when choosing the burst-window K
+    online; ``halflife_s`` sets how fast the estimate tracks a
+    diurnal/MMPP swing (after one halflife of steady traffic the old
+    estimate contributes half the weight).  The first update primes the
+    estimate directly so a cold start doesn't spend a halflife climbing
+    from zero."""
+
+    def __init__(self, halflife_s: float = 5.0):
+        if halflife_s <= 0:
+            raise ValueError("halflife_s must be positive")
+        self.halflife_s = float(halflife_s)
+        self.rate_per_s = 0.0
+        self._primed = False
+
+    def update(self, n_events: int, dt_s: float) -> float:
+        if dt_s <= 0:
+            return self.rate_per_s
+        inst = n_events / dt_s
+        if not self._primed:
+            self.rate_per_s = inst
+            self._primed = True
+        else:
+            a = 0.5 ** (dt_s / self.halflife_s)
+            self.rate_per_s = a * self.rate_per_s + (1.0 - a) * inst
+        return self.rate_per_s
+
+
 def _next_or_none(it):
     try:
         return next(it)

@@ -6,6 +6,14 @@ pending-workload summaries straight from the queue manager
 exposed two ways: typed accessors (``VisibilityService``) and a real HTTP
 endpoint (``serve``) speaking the reference's REST shape — which also
 doubles as the kueueviz dashboard feed (cmd/kueueviz backend).
+
+When constructed with a serving ``AdmissionService`` the same server
+fronts the admission API: ``POST /apis/serving/v1/submit`` (accept /
+429-with-Retry-After / 503-draining), ``GET /apis/serving/v1/position``
+(idempotency-token status + queue position), ``GET
+/apis/serving/v1/pending`` (ingest listing), and ``GET
+/apis/serving/v1/stats`` — with the service's live ``kueue_svc_*``
+gauges on the existing ``/metrics``.
 """
 
 from __future__ import annotations
@@ -157,8 +165,10 @@ class VisibilityServer:
     """The aggregated-API-server equivalent: a real HTTP endpoint
     (reference visibility/server.go:62 + kueueviz backend)."""
 
-    def __init__(self, driver, host: str = "127.0.0.1", port: int = 0):
+    def __init__(self, driver, host: str = "127.0.0.1", port: int = 0,
+                 admission=None):
         self.service = VisibilityService(driver)
+        self.admission = admission   # serving.AdmissionService, optional
         self.host = host
         self.port = port
         self._httpd = None
@@ -166,12 +176,62 @@ class VisibilityServer:
 
     def start(self) -> int:
         from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+        from urllib.parse import parse_qs, urlsplit
 
         service = self.service
+        admission = self.admission
 
         class Handler(BaseHTTPRequestHandler):
             def log_message(self, *a):  # quiet
                 pass
+
+            def _send_json(self, body, code=200, headers=()):
+                payload = json.dumps(body).encode()
+                self.send_response(code)
+                self.send_header("Content-Type", "application/json")
+                self.send_header("Content-Length", str(len(payload)))
+                for k, v in headers:
+                    self.send_header(k, v)
+                self.end_headers()
+                self.wfile.write(payload)
+
+            def do_POST(self):
+                # /apis/serving/v1/submit — the admission API: accept /
+                # reject-with-retry-after / duplicate, all explicit
+                if self.path.split("?")[0] != "/apis/serving/v1/submit" \
+                        or admission is None:
+                    self.send_response(404)
+                    self.end_headers()
+                    return
+                try:
+                    n = int(self.headers.get("Content-Length", "0"))
+                    req = json.loads(self.rfile.read(n) or b"{}")
+                    res = admission.submit(
+                        name=req["name"],
+                        queue_name=req["queue_name"],
+                        requests=req.get("requests", {}),
+                        priority=int(req.get("priority", 0)),
+                        namespace=req.get("namespace", "default"),
+                        runtime_s=float(req.get("runtime_s", 0.0)),
+                        count=int(req.get("count", 1)),
+                        token=req.get("token"))
+                except (KeyError, ValueError, json.JSONDecodeError) as e:
+                    self._send_json({"error": str(e)}, code=400)
+                    return
+                body = {"status": res.status, "token": res.token,
+                        "seq": res.seq, "reason": res.reason,
+                        "duplicate": res.duplicate,
+                        "queue_depth": res.queue_depth,
+                        "retry_after_s": res.retry_after_s}
+                if res.status == "accepted":
+                    self._send_json(body)
+                elif res.status in ("rejected", "draining"):
+                    code = 429 if res.status == "rejected" else 503
+                    self._send_json(body, code=code, headers=(
+                        ("Retry-After",
+                         str(max(1, int(res.retry_after_s + 0.5)))),))
+                else:
+                    self._send_json(body)
 
             def do_GET(self):
                 if self.path.split("?")[0] in ("/", "/index.html"):
@@ -229,6 +289,29 @@ class VisibilityServer:
                     self.send_header("Content-Length", str(len(payload)))
                     self.end_headers()
                     self.wfile.write(payload)
+                    return
+                url = urlsplit(self.path)
+                if url.path.startswith("/apis/serving/v1/"):
+                    # serving admission/visibility API (tokens carry
+                    # "/" so they travel as a query param)
+                    if admission is None:
+                        self.send_response(404)
+                        self.end_headers()
+                        return
+                    rest = url.path[len("/apis/serving/v1/"):]
+                    if rest == "pending":
+                        q = parse_qs(url.query)
+                        limit = int(q.get("limit", ["100"])[0])
+                        self._send_json(admission.pending(limit=limit))
+                    elif rest == "position":
+                        q = parse_qs(url.query)
+                        tok = q.get("token", [""])[0]
+                        self._send_json(admission.queue_position(tok))
+                    elif rest == "stats":
+                        self._send_json(admission.stats())
+                    else:
+                        self.send_response(404)
+                        self.end_headers()
                     return
                 parts = [p for p in self.path.split("?")[0].split("/") if p]
                 # /apis/visibility/v1beta1/clusterqueues/{cq}/pendingworkloads

@@ -1,0 +1,558 @@
+#!/usr/bin/env python
+"""Serving soak: the long-lived admission service under fire.
+
+Four arms, one artifact (SERVE):
+
+- **wall** — a real wall-clock service loop (no virtual time): concurrent
+  submitter threads replay a pre-generated diurnal arrival schedule
+  against ``AdmissionService.serve`` while the adaptive burst window K
+  tracks the load swing online; evidence is per-window p99 admission
+  latency against the SLO plus the K values actually chosen.
+- **kill_restart** — deterministic virtual-time arms: SIGKILL-equivalent
+  chaos crashes (``svc.cycle`` at a step boundary, ``svc.ingest`` inside
+  the submit path) mid-load, then recovery from the durable store + the
+  CycleWAL tail + the ingest journal.  The recovered run must match an
+  unkilled control bit-for-bit in per-cycle decisions and final state
+  digest, lose zero accepted submissions, and duplicate zero admissions
+  (idempotent tokens are exercised by resubmitting the interrupted
+  batch).
+- **drain** — SIGTERM to a serving process: graceful drain must stop
+  accepting (reject with ``draining``), finish in-flight cycles, flush
+  the WAL, and exit clean.
+- **parity** — the same submit-only traffic through the service loop
+  (K pinned to 1) and through ``traffic.runner.run_open_loop`` on a
+  fresh batch driver: per-cycle decisions must be bit-identical.
+
+Artifact: SERVE_r17.json (see README "Serving").
+"""
+
+from __future__ import annotations
+
+import argparse
+import hashlib
+import json
+import os
+import random
+import signal
+import sys
+import tempfile
+import threading
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from kueue_tpu.api.types import (
+    ClusterQueue,
+    FlavorQuotas,
+    LocalQueue,
+    PreemptionPolicy,
+    QueueingStrategy,
+    ResourceFlavor,
+    ResourceGroup,
+    ResourceQuota,
+)
+from kueue_tpu.chaos import injector as chaos
+from kueue_tpu.chaos.injector import ChaosInjector, InjectedCrash
+from kueue_tpu.controller.driver import Driver
+from kueue_tpu.features import env_int
+from kueue_tpu.serving import AdmissionService, ServiceConfig, recover_service
+from kueue_tpu.traffic import (
+    ArrivalStream,
+    DiurnalProcess,
+    OpenLoopConfig,
+    PoissonProcess,
+    ReplayStream,
+    TrafficSpec,
+    run_open_loop,
+)
+from kueue_tpu.utils.journal import CycleWAL
+
+
+class VirtualClock:
+    def __init__(self, t=1000.0):
+        self.t = t
+
+    def __call__(self):
+        return self.t
+
+
+def log(msg: str) -> None:
+    print(msg, file=sys.stderr, flush=True)
+
+
+def _pctile(xs, q):
+    if not xs:
+        return 0.0
+    s = sorted(xs)
+    import math
+    return s[min(len(s) - 1, max(0, math.ceil(q * len(s)) - 1))]
+
+
+# ---------------------------------------------------------------------------
+# Cluster builders (the chaos-soak shape: cohorts of 4, 4000m each,
+# BEST_EFFORT_FIFO so parked re-wakes cannot change admission order)
+# ---------------------------------------------------------------------------
+
+def cluster_spec(n_cqs):
+    def fn(d):
+        d.apply_resource_flavor(ResourceFlavor(name="default"))
+        for q in range(n_cqs):
+            name = f"cq-{q}"
+            d.apply_cluster_queue(ClusterQueue(
+                name=name, cohort=f"co-{q // 4}",
+                queueing_strategy=QueueingStrategy.BEST_EFFORT_FIFO,
+                preemption=PreemptionPolicy(),
+                resource_groups=[ResourceGroup(
+                    covered_resources=["cpu"],
+                    flavors=[FlavorQuotas(name="default", resources={
+                        "cpu": ResourceQuota(nominal=4000)})])]))
+            d.apply_local_queue(LocalQueue(name=f"lq-{q}",
+                                           cluster_queue=name))
+    return fn
+
+
+def build_virtual(n_cqs):
+    clock = VirtualClock()
+    d = Driver(clock=clock, use_device_solver=True)
+    cluster_spec(n_cqs)(d)
+    return d, clock
+
+
+def build_wall(n_cqs):
+    d = Driver(clock=time.time, use_device_solver=True)
+    cluster_spec(n_cqs)(d)
+    return d
+
+
+def full_state(d):
+    out = {}
+    for key, w in d.workloads.items():
+        out[key] = (
+            w.is_finished, w.is_active, w.has_quota_reservation,
+            None if w.admission is None else (
+                w.admission.cluster_queue,
+                tuple((a.name, tuple(sorted(a.flavors.items())),
+                       tuple(sorted(a.resource_usage.items())), a.count)
+                      for a in w.admission.pod_set_assignments)),
+            tuple(sorted((c.type, c.status.value, c.reason, c.message,
+                          c.last_transition_time)
+                         for c in w.conditions.values())),
+            tuple(sorted((s.name, s.state.value)
+                         for s in w.admission_check_states.values())),
+            None if w.requeue_state is None else
+            (w.requeue_state.count, w.requeue_state.requeue_at),
+        )
+    return out
+
+
+def state_digest(d) -> str:
+    blob = repr(sorted(full_state(d).items())).encode()
+    return hashlib.sha256(blob).hexdigest()[:16]
+
+
+def mesh_info() -> dict:
+    import jax
+    devs = jax.devices()
+    return {"n_devices": len(devs),
+            "platform": devs[0].platform if devs else "none"}
+
+
+# ---------------------------------------------------------------------------
+# Arm: wall-clock soak with diurnal swing + online K adaptation
+# ---------------------------------------------------------------------------
+
+def gen_wall_schedule(cfg, seed):
+    """Pre-generate the diurnal submission schedule so the submitter
+    threads replay it at wall pace: (t_rel, name, lq, prio, runtime)."""
+    proc = DiurnalProcess(cfg["wall_trough_rate"], cfg["wall_peak_rate"],
+                          period_s=cfg["wall_duration_s"], seed=seed)
+    marks = random.Random(seed + 1)
+    events, t, i = [], 0.0, 0
+    while True:
+        t += proc.next_gap(t)
+        if t >= cfg["wall_duration_s"]:
+            return events
+        i += 1
+        events.append((t, f"s{i}", f"lq-{marks.randrange(cfg['cqs'])}",
+                       marks.choice((0, 10, 20)), cfg["wall_runtime_s"]))
+
+
+def arm_wall(cfg, seed, td):
+    d = build_wall(cfg["cqs"])
+    wal = CycleWAL(path=os.path.join(td, "wall.wal"))
+    d.attach_wal(wal)
+    svc = AdmissionService(d, config=ServiceConfig(
+        dt_s=cfg["wall_dt_s"], high_water=cfg["high_water"],
+        slo_p99_s=cfg["slo_p99_s"], drain_timeout_s=30.0,
+        journal_path=os.path.join(td, "wall.ing"),
+        k_max=cfg["k_max"], ewma_halflife_s=2.0), wal=wal)
+    events = gen_wall_schedule(cfg, seed)
+    stop = threading.Event()
+    server = threading.Thread(target=svc.serve, args=(stop,), daemon=True)
+    server.start()
+    t_start = time.perf_counter()
+    n_threads = cfg["wall_submitters"]
+
+    def submitter(lane):
+        for (t_rel, name, lq, prio, rt) in events[lane::n_threads]:
+            lag = t_start + t_rel - time.perf_counter()
+            if lag > 0:
+                time.sleep(lag)
+            svc.submit(name=name, queue_name=lq, requests={"cpu": 1500},
+                       priority=prio, runtime_s=rt)
+
+    subs = [threading.Thread(target=submitter, args=(i,), daemon=True)
+            for i in range(n_threads)]
+    for s in subs:
+        s.start()
+    for s in subs:
+        s.join()
+    # let the tail admit, then drain and stop
+    time.sleep(4 * cfg["wall_dt_s"])
+    stop.set()
+    server.join(timeout=svc.cfg.drain_timeout_s + 10.0)
+    duration = cfg["wall_duration_s"]
+    n_windows = cfg["wall_windows"]
+    w_len = duration / n_windows
+    windows = []
+    for w in range(n_windows):
+        lo, hi = w * w_len, (w + 1) * w_len
+        lats = [lat for (t, lat) in svc.latency_log if lo <= t < hi]
+        ks = [s["k"] for s in svc.telemetry if lo <= s["t_wall"] < hi]
+        rates = [s["ewma_rate"] for s in svc.telemetry
+                 if lo <= s["t_wall"] < hi]
+        windows.append({
+            "t0_s": lo, "samples": len(lats),
+            "p99_s": _pctile(lats, 0.99),
+            "rate_per_s": (sum(rates) / len(rates)) if rates else 0.0,
+            "k_max": max(ks) if ks else 0,
+        })
+    active = [w for w in windows if w["samples"] > 0]
+    held = bool(active) and all(w["p99_s"] <= cfg["slo_p99_s"]
+                                for w in active)
+    k_values = sorted({s["k"] for s in svc.telemetry})
+    stats = svc.stats()
+    return {
+        "wall_clock": True,
+        "duration_s": duration,
+        "submitted": stats["accepted"],
+        "admitted": stats["admitted"],
+        "admissions_per_s": stats["admitted"] / duration,
+        "drained_clean": stats["drained_clean"],
+        "slo": {
+            "p99_target_s": cfg["slo_p99_s"],
+            "held": held,
+            "windows": windows,
+            "k_values": k_values,
+            "k_adapted": len(k_values) > 1,
+        },
+        "backpressure": {
+            "high_water": cfg["high_water"],
+            "rejected": stats["rejected"],
+            "shed": stats["shed"],
+        },
+        "arrivals": {"process": "diurnal",
+                     "trough_rate_per_s": cfg["wall_trough_rate"],
+                     "peak_rate_per_s": cfg["wall_peak_rate"],
+                     "events": len(events)},
+    }
+
+
+# ---------------------------------------------------------------------------
+# Arm: kill mid-load + restart vs unkilled control (virtual time)
+# ---------------------------------------------------------------------------
+
+def gen_kill_schedule(cfg, seed):
+    """Per-step submission batches, deterministic: heavier even steps
+    keep a backlog alive across the kill point."""
+    rng = random.Random(seed)
+    out, n = [], 0
+    for s in range(cfg["kill_steps"]):
+        batch = []
+        for _ in range(3 if s % 2 == 0 else 1):
+            n += 1
+            batch.append((f"w{n}", f"lq-{rng.randrange(cfg['cqs'])}",
+                          rng.choice((0, 10, 20)),
+                          float(rng.choice((2, 3)))))
+        out.append(batch)
+    return out
+
+
+def run_killable(cfg, sched, kill_site, kill_at, td, tag):
+    """One serving run over ``sched``; when ``kill_site`` is armed the
+    run crashes, recovers from store + WAL + ingest journal, resubmits
+    the interrupted batch (idempotent tokens), and continues."""
+    d, clock = build_virtual(cfg["cqs"])
+    wal = CycleWAL(path=os.path.join(td, f"{tag}.wal"))
+    d.attach_wal(wal)
+    jpath = os.path.join(td, f"{tag}.ing")
+    svc_cfg = ServiceConfig(dt_s=1.0, k_max=1, journal_path=jpath,
+                            high_water=1 << 30, epoch_t=clock.t)
+    svc = AdmissionService(d, config=svc_cfg, wal=wal)
+    if kill_site is not None:
+        inj = chaos.install(ChaosInjector(seed=1000 + kill_at))
+        inj.arm(kill_site, at=kill_at)
+    decisions, crashed, s = [], None, 0
+    while s < len(sched):
+        try:
+            for (name, lq, prio, rt) in sched[s]:
+                svc.submit(name=name, queue_name=lq,
+                           requests={"cpu": 1500}, priority=prio,
+                           runtime_s=rt)
+            out = svc.step()
+            decisions.extend(out["decisions"])
+            s += 1
+        except InjectedCrash as e:
+            crashed = str(e)
+            chaos.clear()
+            d2 = Driver(clock=clock, use_device_solver=True)
+            cluster_spec(cfg["cqs"])(d2)
+            # a fresh process: same durable store + WAL + ingest journal
+            svc = recover_service(
+                d2, d.workloads.values(), wal,
+                config=ServiceConfig(dt_s=1.0, k_max=1,
+                                     journal_path=jpath,
+                                     high_water=1 << 30,
+                                     epoch_t=svc_cfg.epoch_t))
+            d = d2
+    return d, svc, decisions, crashed
+
+
+def arm_kill_restart(cfg, seed, td):
+    sched = gen_kill_schedule(cfg, seed)
+    d_c, svc_c, dec_c, _ = run_killable(cfg, sched, None, 0, td, "ctl")
+    digest_c = state_digest(d_c)
+    accepted_keys = [f"default/{name}" for batch in sched
+                     for (name, _, _, _) in batch]
+    scenarios = {}
+    lost_total = dup_total = 0
+    all_identical = all_digests = True
+    arms = [("cycle_kill", "svc.cycle", cfg["kill_steps"] // 2 + 1),
+            ("ingest_kill", "svc.ingest",
+             max(2, len(accepted_keys) // 2))]
+    for tag, site, at in arms:
+        d_k, svc_k, dec_k, crashed = run_killable(
+            cfg, sched, site, at, td, tag)
+        digest_k = state_digest(d_k)
+        flat = [k for cyc in dec_k for k in cyc]
+        dup = sum(1 for k in set(flat) if flat.count(k) > 1)
+        lost = sum(1 for k in accepted_keys
+                   if k not in d_k.workloads)
+        identical = dec_k == dec_c
+        digests = digest_k == digest_c
+        scenarios[tag] = {
+            "site": site, "crashed": crashed,
+            "cycles": len(dec_k),
+            "decisions_identical": identical,
+            "digest": digest_k,
+            "digests_match": digests,
+            "lost_accepted_submissions": lost,
+            "duplicated_admissions": dup,
+            "duplicate_tokens_resubmitted": svc_k.duplicate_total,
+            "sheds": len(svc_k.journal.shed_seqs),
+        }
+        lost_total += lost
+        dup_total += dup
+        all_identical = all_identical and identical
+        all_digests = all_digests and digests
+        log(f"  kill[{tag}]: crashed={crashed} identical={identical} "
+            f"digests={digests} lost={lost} dup={dup}")
+    return {
+        "control_digest": digest_c,
+        "control_cycles": len(dec_c),
+        "scenarios": scenarios,
+        "lost_accepted_submissions": lost_total,
+        "duplicated_admissions": dup_total,
+        "decisions_identical": all_identical,
+        "digests_match": all_digests,
+    }
+
+
+# ---------------------------------------------------------------------------
+# Arm: SIGTERM graceful drain (wall clock, real signal)
+# ---------------------------------------------------------------------------
+
+def arm_drain(cfg, seed, td):
+    d = build_wall(cfg["cqs"])
+    wal = CycleWAL(path=os.path.join(td, "drain.wal"))
+    d.attach_wal(wal)
+    svc = AdmissionService(d, config=ServiceConfig(
+        dt_s=cfg["wall_dt_s"], high_water=cfg["high_water"],
+        drain_timeout_s=20.0,
+        journal_path=os.path.join(td, "drain.ing"), k_max=cfg["k_max"]),
+        wal=wal)
+    svc.install_signal_handlers()
+    server = threading.Thread(target=svc.serve, daemon=True)
+    server.start()
+    n_subs = cfg["drain_submissions"]
+
+    def submitter(lane):
+        for i in range(lane, n_subs, 2):
+            svc.submit(name=f"d{i}", queue_name=f"lq-{i % cfg['cqs']}",
+                       requests={"cpu": 1500}, priority=0,
+                       runtime_s=cfg["wall_runtime_s"])
+    subs = [threading.Thread(target=submitter, args=(i,), daemon=True)
+            for i in range(2)]
+    t0 = time.perf_counter()
+    for s in subs:
+        s.start()
+    for s in subs:
+        s.join()
+    os.kill(os.getpid(), signal.SIGTERM)   # graceful drain, not death
+    server.join(timeout=30.0)
+    drain_wall = time.perf_counter() - t0
+    post = svc.submit(name="late", queue_name="lq-0",
+                      requests={"cpu": 1500})
+    stats = svc.stats()
+    applied = sum(1 for i in range(n_subs)
+                  if f"default/d{i}" in d.workloads)
+    wal_flushed = (wal.stats.get("wal_flushes", 0) > 0
+                   and len(wal.tail) == 0)
+    clean = (not server.is_alive() and svc.stopped
+             and svc.drained_clean and stats["ingest_depth"] == 0)
+    return {
+        "clean": clean,
+        "wal_flushed": wal_flushed,
+        "accepted": stats["accepted"],
+        "applied_in_store": applied,
+        "zero_lost": applied == stats["accepted"] - stats["shed"],
+        "rejected_after_drain": post.status == "draining",
+        "drain_wall_s": drain_wall,
+        "journal": stats["journal"],
+    }
+
+
+# ---------------------------------------------------------------------------
+# Arm: decision parity service loop vs batch open-loop runner
+# ---------------------------------------------------------------------------
+
+def arm_parity(cfg, seed):
+    n, dt, cycles = cfg["cqs"], 1.0, cfg["parity_cycles"]
+    spec = TrafficSpec(n_cqs=n, cancel_fraction=0.0, churn_fraction=0.0,
+                       runtime_choices_s=(2.0, 4.0))
+    stream = ArrivalStream(PoissonProcess(cfg["parity_rate"], seed=seed),
+                           spec, seed=seed)
+    events = []
+    for ev in stream:
+        if ev.t > cycles * dt:
+            break
+        events.append(ev)
+    # batch arm: the open-loop runner
+    d1, c1 = build_virtual(n)
+    res = run_open_loop(d1, c1, ReplayStream(events),
+                        OpenLoopConfig(duration_s=cycles * dt, dt_s=dt))
+    # service arm: same events through submit/step, K pinned to 1
+    d2, c2 = build_virtual(n)
+    svc = AdmissionService(d2, config=ServiceConfig(
+        dt_s=dt, k_max=1, journal_path="", high_water=1 << 30,
+        epoch_t=c2.t))
+    decisions, i = [], 0
+    for k in range(cycles):
+        t_k = (k + 1) * dt
+        while i < len(events) and events[i].t <= t_k:
+            ev = events[i]
+            i += 1
+            ns, name = ev.key.split("/", 1)
+            svc.submit(name=name, namespace=ns,
+                       queue_name=f"lq-{ev.cq}",
+                       requests={"cpu": ev.cpu_m}, priority=ev.priority,
+                       creation_time=svc.epoch + ev.t,
+                       runtime_s=ev.runtime_s)
+        out = svc.step()
+        decisions.extend(out["decisions"])
+    identical = decisions == res.decisions
+    digests = state_digest(d1) == state_digest(d2)
+    return {
+        "cycles": cycles,
+        "events": len(events),
+        "service_admitted": sum(len(c) for c in decisions),
+        "batch_admitted": sum(len(c) for c in res.decisions),
+        "decisions_identical": identical,
+        "state_digests_match": digests,
+    }
+
+
+# ---------------------------------------------------------------------------
+# main
+# ---------------------------------------------------------------------------
+
+def main() -> int:
+    ap = argparse.ArgumentParser(description=__doc__)
+    ap.add_argument("--cqs", type=int, default=16)
+    ap.add_argument("--seed", type=int,
+                    default=env_int("KUEUE_TPU_SVC_SEED"))
+    ap.add_argument("--quick", action="store_true",
+                    help="CI-sized: 8 CQs, ~6s wall arm")
+    ap.add_argument("--out", default="SERVE_r17.json")
+    args = ap.parse_args()
+
+    cfg = {
+        "cqs": 8 if args.quick else args.cqs,
+        "wall_dt_s": 0.25,
+        "wall_duration_s": 6.0 if args.quick else 24.0,
+        "wall_trough_rate": 4.0 if args.quick else 8.0,
+        "wall_peak_rate": 48.0 if args.quick else 96.0,
+        "wall_runtime_s": 0.3,
+        "wall_submitters": 4,
+        "wall_windows": 6 if args.quick else 8,
+        "slo_p99_s": 2.0,
+        "high_water": env_int("KUEUE_TPU_SVC_HIGH_WATER"),
+        "k_max": 8,
+        "kill_steps": 14 if args.quick else 28,
+        "drain_submissions": 40 if args.quick else 160,
+        "parity_cycles": 20 if args.quick else 48,
+        "parity_rate": 4.0,
+    }
+    if args.quick:
+        cfg["cqs"] = 8
+    seed = args.seed
+    t0 = time.perf_counter()
+    with tempfile.TemporaryDirectory() as td:
+        log(f"serve soak: cqs={cfg['cqs']} seed={seed} "
+            f"quick={args.quick}")
+        log("arm: parity")
+        parity = arm_parity(cfg, seed)
+        log(f"  parity: identical={parity['decisions_identical']} "
+            f"admitted={parity['service_admitted']}")
+        log("arm: kill_restart")
+        kill = arm_kill_restart(cfg, seed + 1, td)
+        log("arm: drain")
+        drain = arm_drain(cfg, seed + 2, td)
+        log(f"  drain: clean={drain['clean']} "
+            f"wal_flushed={drain['wal_flushed']}")
+        log("arm: wall")
+        wall = arm_wall(cfg, seed + 3, td)
+        log(f"  wall: adm/s={wall['admissions_per_s']:.1f} "
+            f"held={wall['slo']['held']} k={wall['slo']['k_values']}")
+
+    all_ok = (parity["decisions_identical"]
+              and kill["decisions_identical"] and kill["digests_match"]
+              and kill["lost_accepted_submissions"] == 0
+              and kill["duplicated_admissions"] == 0
+              and drain["clean"] and drain["wal_flushed"]
+              and wall["slo"]["held"])
+    art = {
+        "metric": "serve_soak_wall_admissions_per_s",
+        "unit": "admissions/s",
+        "value": wall["admissions_per_s"],
+        "cqs": cfg["cqs"],
+        "seed": seed,
+        "quick": bool(args.quick),
+        "mesh": mesh_info(),
+        "config": cfg,
+        "wall": wall,
+        "kill_restart": kill,
+        "drain": drain,
+        "parity": parity,
+        "all_ok": all_ok,
+        "elapsed_s": time.perf_counter() - t0,
+    }
+    with open(args.out, "w") as fh:
+        json.dump(art, fh, indent=1, sort_keys=True)
+    log(f"wrote {args.out} (all_ok={all_ok}, "
+        f"{art['elapsed_s']:.1f}s)")
+    return 0 if all_ok else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -574,11 +574,95 @@ def _check_fed(d, path, out):
         _err(out, path, "missing numeric 'elapsed_s'")
 
 
+def _check_serve(d, path, out):
+    """SERVE_* serving-soak artifacts (scripts/serve_soak.py): a
+    wall-clock soak holding the p99 admission-latency SLO across a
+    diurnal swing with the burst window K adapted online, kill/restart
+    arms converging bit-identically to an unkilled control with zero
+    accepted submissions lost and zero admissions duplicated, a clean
+    SIGTERM drain with the WAL flushed, and decision parity between the
+    service path and the batch open-loop runner."""
+    wall = d.get("wall")
+    if not isinstance(wall, dict):
+        _err(out, path, "missing 'wall' block")
+        wall = {}
+    if wall.get("wall_clock") is not True:
+        _err(out, path, "'wall.wall_clock' must be true (the serving "
+             "soak is a real wall-clock run)")
+    for k in ("duration_s", "admissions_per_s"):
+        if not isinstance(wall.get(k), (int, float)):
+            _err(out, path, f"missing numeric 'wall.{k}'")
+    slo = wall.get("slo")
+    if not isinstance(slo, dict):
+        _err(out, path, "missing 'wall.slo' block")
+        slo = {}
+    if not isinstance(slo.get("p99_target_s"), (int, float)):
+        _err(out, path, "missing numeric 'wall.slo.p99_target_s'")
+    if slo.get("held") is not True:
+        _err(out, path, "'wall.slo.held' must be true: the service "
+             "must hold the p99 SLO across the load swing")
+    windows = slo.get("windows")
+    if not isinstance(windows, list) or len(windows) < 2:
+        _err(out, path, "'wall.slo.windows' needs >= 2 windows "
+             "(the SLO must hold across a swing, not one average)")
+    else:
+        for i, w in enumerate(windows):
+            if not isinstance(w, dict) \
+                    or not isinstance(w.get("p99_s"), (int, float)):
+                _err(out, path, f"window {i} missing numeric 'p99_s'")
+    if slo.get("k_adapted") is not True:
+        _err(out, path, "'wall.slo.k_adapted' must be true: the burst "
+             "window K must actually move with the load swing")
+    kill = d.get("kill_restart")
+    if not isinstance(kill, dict):
+        _err(out, path, "missing 'kill_restart' block")
+        kill = {}
+    if kill.get("lost_accepted_submissions") != 0:
+        _err(out, path, "'kill_restart.lost_accepted_submissions'="
+             f"{kill.get('lost_accepted_submissions')}: restart must "
+             "lose zero accepted submissions")
+    if kill.get("duplicated_admissions") != 0:
+        _err(out, path, "'kill_restart.duplicated_admissions'="
+             f"{kill.get('duplicated_admissions')}: restart must "
+             "duplicate zero admissions")
+    if kill.get("decisions_identical") is not True:
+        _err(out, path, "'kill_restart.decisions_identical' must be "
+             "true against the unkilled control")
+    if kill.get("digests_match") is not True:
+        _err(out, path, "'kill_restart.digests_match' must be true "
+             "against the unkilled control")
+    scen = kill.get("scenarios")
+    if not isinstance(scen, dict) or len(scen) < 2:
+        _err(out, path, "'kill_restart.scenarios' needs >= 2 kill "
+             "sites (cycle boundary and ingest path)")
+    drain = d.get("drain")
+    if not isinstance(drain, dict):
+        _err(out, path, "missing 'drain' block")
+        drain = {}
+    if drain.get("clean") is not True:
+        _err(out, path, "'drain.clean' must be true: SIGTERM must "
+             "drain and exit clean")
+    if not isinstance(drain.get("wal_flushed"), bool):
+        _err(out, path, "missing bool 'drain.wal_flushed'")
+    elif not drain["wal_flushed"]:
+        _err(out, path, "'drain.wal_flushed' must be true")
+    parity = d.get("parity")
+    if not isinstance(parity, dict):
+        _err(out, path, "missing 'parity' block")
+        parity = {}
+    if parity.get("decisions_identical") is not True:
+        _err(out, path, "'parity.decisions_identical' must be true: "
+             "service-path decisions must be bit-identical to the "
+             "batch open-loop runner")
+    if not isinstance(d.get("elapsed_s"), (int, float)):
+        _err(out, path, "missing numeric 'elapsed_s'")
+
+
 # generator scripts that postdate the schema convention (metric+value
 # at top level); older BENCH_/MULTICHIP_r01-05 wrappers predate it and
 # only get the common checks
 _STRICT_PREFIXES = ("NORTHSTAR_", "CHAOS_", "TRAFFIC_", "SCALE_",
-                    "LINT_", "FED_", "OBS_")
+                    "LINT_", "FED_", "OBS_", "SERVE_")
 
 
 def validate(path: str) -> list[str]:
@@ -615,6 +699,10 @@ def validate(path: str) -> list[str]:
     # artifact even if the file was renamed
     if base.startswith("OBS_") or "overhead" in d:
         _check_obs(d, path, out)
+    # by name or by shape: a kill_restart+wall pair marks a serving-soak
+    # record even if the file was renamed
+    if base.startswith("SERVE_") or ("kill_restart" in d and "wall" in d):
+        _check_serve(d, path, out)
     # from r16 on, every NORTHSTAR/TRAFFIC/FED soak artifact must carry
     # the obs block (the telemetry plane rides every soak)
     rnd = re.match(r"(?:NORTHSTAR|TRAFFIC|FED)_R(\d+)", base)

@@ -1,0 +1,582 @@
+"""Admission-service tests: the long-lived serving loop around Driver.
+
+Tier-1 slice of the serving tentpole (the wall-clock soak lives in
+scripts/serve_soak.py): submit/step mechanics, idempotent submission
+tokens, backpressure (reject-with-retry-after, shed-lowest-priority),
+the adaptive burst window, concurrent submitters racing the
+cycle-boundary drain (digest parity against a serial control), all
+three ``svc.*`` chaos sites armed with recovery proven against the
+durable ingest journal + CycleWAL, SIGTERM/graceful drain, the
+thread-safety of ``metrics.Registry`` under a multi-threaded hammer,
+and the serving HTTP surface on ``VisibilityServer``.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import json
+import threading
+import urllib.error
+import urllib.request
+
+import pytest
+
+from kueue_tpu.api.types import (
+    ClusterQueue,
+    FlavorQuotas,
+    LocalQueue,
+    PreemptionPolicy,
+    QueueingStrategy,
+    ResourceFlavor,
+    ResourceGroup,
+    ResourceQuota,
+)
+from kueue_tpu.chaos import injector as chaos
+from kueue_tpu.chaos.injector import ChaosInjector, InjectedCrash
+from kueue_tpu.controller.driver import Driver
+from kueue_tpu.metrics import Registry
+from kueue_tpu.serving import (
+    AdmissionService,
+    ServiceConfig,
+    recover_service,
+)
+from kueue_tpu.traffic import RateEWMA
+from kueue_tpu.utils.journal import CycleWAL, IngestJournal
+from kueue_tpu.visibility import VisibilityServer
+
+
+@pytest.fixture(autouse=True)
+def _chaos_off():
+    """Chaos must never leak into the rest of the suite."""
+    chaos.clear()
+    yield
+    chaos.clear()
+
+
+class VirtualClock:
+    def __init__(self, t=1000.0):
+        self.t = t
+
+    def __call__(self):
+        return self.t
+
+
+def build(n_cqs=2, clock=None):
+    clock = clock if clock is not None else VirtualClock()
+    d = Driver(clock=clock, use_device_solver=False)
+    d.apply_resource_flavor(ResourceFlavor(name="default"))
+    for q in range(n_cqs):
+        d.apply_cluster_queue(ClusterQueue(
+            name=f"cq-{q}", cohort="co",
+            queueing_strategy=QueueingStrategy.BEST_EFFORT_FIFO,
+            preemption=PreemptionPolicy(),
+            resource_groups=[ResourceGroup(
+                covered_resources=["cpu"],
+                flavors=[FlavorQuotas(name="default", resources={
+                    "cpu": ResourceQuota(nominal=4000)})])]))
+        d.apply_local_queue(LocalQueue(name=f"lq-{q}",
+                                       cluster_queue=f"cq-{q}"))
+    return d, clock
+
+
+def mk_service(d, clock, **over):
+    kw = dict(dt_s=1.0, k_max=1, journal_path="", high_water=1 << 20,
+              epoch_t=clock.t)
+    kw.update(over)
+    return AdmissionService(d, config=ServiceConfig(**kw))
+
+
+def state_digest(d) -> str:
+    rows = []
+    for key, w in sorted(d.workloads.items()):
+        rows.append((key, w.is_finished, w.has_quota_reservation,
+                     None if w.admission is None
+                     else w.admission.cluster_queue,
+                     tuple(sorted((c.type, c.status.value,
+                                   c.last_transition_time)
+                                  for c in w.conditions.values()))))
+    return hashlib.sha256(repr(rows).encode()).hexdigest()[:16]
+
+
+# ---------------------------------------------------------------------------
+# Submit / step mechanics
+# ---------------------------------------------------------------------------
+
+def test_submit_step_admits():
+    d, clock = build()
+    svc = mk_service(d, clock)
+    for i in range(3):
+        res = svc.submit(name=f"w{i}", queue_name="lq-0",
+                         requests={"cpu": 1500})
+        assert res.status == "accepted"
+        assert res.seq == i + 1
+    out = svc.step()
+    # one CQ head admits per cycle: w0 now, w1 next cycle, and w2 is
+    # over quota (4000m holds exactly two 1500m workloads)
+    assert out["decisions"] == [["default/w0"]]
+    svc.step()
+    assert svc.admitted_total == 2
+    assert svc.stats()["ingest_depth"] == 0
+    assert svc.journal.stats["ing_applies"] == 1
+    assert svc.queue_position("default/w0")["status"] == "admitted"
+    assert svc.queue_position("default/w2")["status"] == "queued"
+    assert svc.queue_position("nope")["status"] == "unknown"
+
+
+def test_runtime_finish_frees_quota():
+    d, clock = build(n_cqs=1)
+    svc = mk_service(d, clock)
+    for i in range(4):
+        svc.submit(name=f"w{i}", queue_name="lq-0",
+                   requests={"cpu": 1500}, runtime_s=1.0)
+    # one head per cycle; runtime 1.0 at dt 1.0 finishes each admitted
+    # workload the next cycle, so the backlog drains one per step
+    for _ in range(4):
+        svc.step()
+    assert svc.admitted_total == 4
+    assert svc.queue_position("default/w0")["status"] == "finished"
+
+
+def test_idempotent_tokens():
+    d, clock = build()
+    svc = mk_service(d, clock)
+    first = svc.submit(name="w0", queue_name="lq-0",
+                       requests={"cpu": 1500})
+    again = svc.submit(name="w0", queue_name="lq-0",
+                       requests={"cpu": 1500})
+    assert again.duplicate is True
+    assert again.seq == first.seq
+    assert svc.accepted_total == 1
+    assert svc.duplicate_total == 1
+    assert svc.journal.seq == 1          # nothing re-journaled
+    svc.step()
+    # a repeat after admission still reports the settled outcome
+    late = svc.submit(name="w0", queue_name="lq-0",
+                      requests={"cpu": 1500})
+    assert late.duplicate is True and late.status == "accepted"
+    assert svc.ingest.depth() == 0       # never re-enqueued
+
+
+# ---------------------------------------------------------------------------
+# Backpressure: reject with retry-after, shed lowest priority first
+# ---------------------------------------------------------------------------
+
+def test_backpressure_rejects_at_high_water():
+    d, clock = build()
+    svc = mk_service(d, clock, high_water=2)
+    for i in range(2):
+        svc.submit(name=f"w{i}", queue_name="lq-0",
+                   requests={"cpu": 1500}, priority=10)
+    res = svc.submit(name="w2", queue_name="lq-0",
+                     requests={"cpu": 1500}, priority=10)
+    assert res.status == "rejected"
+    assert res.reason == "backpressure"
+    assert res.retry_after_s > 0
+    assert svc.rejected_total == 1
+    assert svc.ingest.depth() == 2       # queue untouched
+
+
+def test_backpressure_sheds_lowest_priority_for_higher():
+    d, clock = build()
+    svc = mk_service(d, clock, high_water=2)
+    svc.submit(name="lo0", queue_name="lq-0", requests={"cpu": 1500},
+               priority=0)
+    svc.submit(name="lo1", queue_name="lq-0", requests={"cpu": 1500},
+               priority=0)
+    res = svc.submit(name="hi", queue_name="lq-0",
+                     requests={"cpu": 1500}, priority=20)
+    assert res.status == "accepted"
+    assert svc.shed_total == 1
+    assert svc.ingest.depth() == 2
+    # the victim is the youngest of the lowest-priority entries, its
+    # outcome is recorded (never a silent drop), and it is journaled
+    assert svc.queue_position("default/lo1")["status"] == "shed"
+    assert svc.queue_position("default/lo0")["status"] == "pending"
+    assert svc.journal.stats["ing_sheds"] == 1
+    svc.step()
+    admitted = [k for cyc in svc.telemetry[-1]["decisions"] for k in cyc]
+    assert "default/hi" in admitted and "default/lo1" not in admitted
+
+
+def test_adaptive_burst_window_tracks_backlog():
+    d, clock = build(n_cqs=1)
+    svc = mk_service(d, clock, k_max=8, ewma_halflife_s=1.0)
+    # a burst far beyond one cycle's capacity → K climbs the ladder;
+    # runtime-driven finishes keep quota recycling
+    for i in range(24):
+        svc.submit(name=f"b{i}", queue_name="lq-0",
+                   requests={"cpu": 1500}, runtime_s=1.0)
+    out = svc.step()
+    assert out["k"] > 1
+    while svc.admitted_total < 24:
+        svc.step()
+    ks = {s["k"] for s in svc.telemetry}
+    assert max(ks) > 1                   # adapted up under the burst
+    for _ in range(8):                   # idle: the EWMA decays
+        svc.step()
+    assert svc.telemetry[-1]["k"] == 1   # and back down when idle
+
+
+# ---------------------------------------------------------------------------
+# Concurrent submitters racing the cycle-boundary drain
+# ---------------------------------------------------------------------------
+
+def _concurrent_submit(svc, n, threads, epoch):
+    """Race ``threads`` submitters over n submissions with explicit
+    deterministic creation_times, so scheduler order is independent of
+    the journal-seq interleaving the race produces."""
+    barrier = threading.Barrier(threads)
+    errs = []
+
+    def worker(lane):
+        try:
+            barrier.wait()
+            for i in range(lane, n, threads):
+                svc.submit(name=f"c{i}", queue_name=f"lq-{i % 2}",
+                           requests={"cpu": 1500},
+                           priority=(i % 3) * 10,
+                           creation_time=epoch + i * 0.01)
+        except Exception as e:           # pragma: no cover
+            errs.append(e)
+
+    ts = [threading.Thread(target=worker, args=(k,))
+          for k in range(threads)]
+    for t in ts:
+        t.start()
+    for t in ts:
+        t.join()
+    assert errs == []
+
+
+def test_concurrent_ingest_matches_serial_control():
+    """Satellite: submitters racing the drain never drop, never
+    double-apply, and admission state converges bit-identically to a
+    serial control (distinct creation_times make scheduler order
+    independent of arrival interleaving)."""
+    n, steps = 36, 6
+    # serial control
+    d1, c1 = build()
+    ctl = mk_service(d1, c1)
+    for i in range(n):
+        ctl.submit(name=f"c{i}", queue_name=f"lq-{i % 2}",
+                   requests={"cpu": 1500}, priority=(i % 3) * 10,
+                   creation_time=ctl.epoch + i * 0.01)
+    ctl_decisions = [ctl.step()["decisions"] for _ in range(steps)]
+    # racing arm
+    d2, c2 = build()
+    svc = mk_service(d2, c2)
+    _concurrent_submit(svc, n, threads=4, epoch=svc.epoch)
+    assert svc.accepted_total == n       # nothing dropped at ingest
+    decisions = [svc.step()["decisions"] for _ in range(steps)]
+    assert decisions == ctl_decisions
+    assert state_digest(d2) == state_digest(d1)
+    flat = [k for s in decisions for cyc in s for k in cyc]
+    assert len(flat) == len(set(flat))   # nothing double-applied
+    assert svc.journal.stats["ing_accepts"] == n
+
+
+def test_submitters_racing_live_drain_lose_nothing():
+    """Liveness under a true race: submissions landing while step()
+    drains concurrently are all applied exactly once."""
+    d, clock = build()
+    svc = mk_service(d, clock)
+    n = 60
+    done = threading.Event()
+
+    def stepper():
+        while not done.is_set():
+            svc.step()
+
+    st = threading.Thread(target=stepper)
+    st.start()
+    try:
+        _concurrent_submit(svc, n, threads=4, epoch=svc.epoch)
+    finally:
+        done.set()
+        st.join()
+    svc.step()                           # settle the last batch
+    assert svc.accepted_total == n
+    assert svc.ingest.depth() == 0
+    assert len(d.workloads) == n         # applied exactly once each
+    assert svc.journal.stats["ing_accepts"] == n
+
+
+# ---------------------------------------------------------------------------
+# Chaos sites + recovery: svc.ingest / svc.cycle / svc.shutdown
+# ---------------------------------------------------------------------------
+
+def test_ingest_crash_recovers_accepted_submission(tmp_path):
+    """svc.ingest: the crash lands after the durable accept record but
+    before the in-memory enqueue — recovery must re-enqueue from the
+    journal, losing nothing."""
+    d, clock = build()
+    wal = CycleWAL(path=str(tmp_path / "a.wal"))
+    d.attach_wal(wal)
+    jp = str(tmp_path / "a.ing")
+    cfg = ServiceConfig(dt_s=1.0, k_max=1, journal_path=jp,
+                        high_water=1 << 20, epoch_t=clock.t)
+    svc = AdmissionService(d, config=cfg, wal=wal)
+    svc.submit(name="w0", queue_name="lq-0", requests={"cpu": 1500})
+    inj = chaos.install(ChaosInjector(seed=7))
+    inj.arm("svc.ingest", at=1)
+    with pytest.raises(InjectedCrash):
+        svc.submit(name="w1", queue_name="lq-0", requests={"cpu": 1500})
+    chaos.clear()
+    d2, _ = build(clock=clock)
+    svc2 = recover_service(d2, d.workloads.values(), wal, config=cfg)
+    # both accepted submissions survive, as does the idempotent token
+    assert svc2.ingest.depth() == 2
+    assert svc2.submit(name="w1", queue_name="lq-0",
+                       requests={"cpu": 1500}).duplicate is True
+    svc2.step()
+    svc2.step()                          # one CQ head admits per cycle
+    assert svc2.queue_position("default/w0")["status"] == "admitted"
+    assert svc2.queue_position("default/w1")["status"] == "admitted"
+
+
+def test_cycle_crash_recovery_matches_control(tmp_path):
+    """svc.cycle: SIGKILL at a step boundary mid-load; the recovered
+    run's remaining decisions and final state must be bit-identical to
+    an unkilled control."""
+    batches = [[("w1", 0), ("w2", 10)], [("w3", 0)], [("w4", 20)],
+               [("w5", 0)], []]
+
+    def run(kill_at, tag):
+        d, clock = build()
+        wal = CycleWAL(path=str(tmp_path / f"{tag}.wal"))
+        d.attach_wal(wal)
+        cfg = ServiceConfig(dt_s=1.0, k_max=1,
+                            journal_path=str(tmp_path / f"{tag}.ing"),
+                            high_water=1 << 20, epoch_t=clock.t)
+        svc = AdmissionService(d, config=cfg, wal=wal)
+        if kill_at:
+            chaos.install(ChaosInjector(seed=3)).arm("svc.cycle",
+                                                     at=kill_at)
+        decisions, s = [], 0
+        while s < len(batches):
+            try:
+                for (name, prio) in batches[s]:
+                    svc.submit(name=name, queue_name="lq-0",
+                               requests={"cpu": 1500}, priority=prio,
+                               runtime_s=2.0)
+                decisions.append(svc.step()["decisions"])
+                s += 1
+            except InjectedCrash:
+                chaos.clear()
+                d2, _ = build(clock=clock)
+                svc = recover_service(d2, d.workloads.values(), wal,
+                                      config=cfg)
+                d = d2
+        return d, decisions
+
+    d_ctl, dec_ctl = run(0, "ctl")
+    d_kill, dec_kill = run(3, "kill")
+    assert dec_kill == dec_ctl
+    assert state_digest(d_kill) == state_digest(d_ctl)
+
+
+def test_shutdown_crash_then_recovered_drain(tmp_path):
+    """svc.shutdown: the crash lands mid graceful drain, after the
+    in-flight cycles but before the final flush — the durable journal
+    still carries everything, and a recovered service drains clean."""
+    d, clock = build()
+    wal = CycleWAL(path=str(tmp_path / "s.wal"))
+    d.attach_wal(wal)
+    cfg = ServiceConfig(dt_s=1.0, k_max=1,
+                        journal_path=str(tmp_path / "s.ing"),
+                        high_water=1 << 20, epoch_t=clock.t)
+    svc = AdmissionService(d, config=cfg, wal=wal)
+    svc.submit(name="w0", queue_name="lq-0", requests={"cpu": 1500})
+    chaos.install(ChaosInjector(seed=5)).arm("svc.shutdown", at=1)
+    with pytest.raises(InjectedCrash):
+        svc.drain()
+    chaos.clear()
+    assert not svc.stopped               # died before the epilogue
+    d2, _ = build(clock=clock)
+    svc2 = recover_service(d2, d.workloads.values(), wal, config=cfg)
+    assert svc2.drain() is True
+    assert svc2.stopped and svc2.drained_clean
+    assert svc2.queue_position("default/w0")["status"] == "admitted"
+
+
+def test_graceful_drain_stops_accepting():
+    d, clock = build()
+    svc = mk_service(d, clock)
+    svc.submit(name="w0", queue_name="lq-0", requests={"cpu": 1500})
+    svc.request_drain()
+    res = svc.submit(name="late", queue_name="lq-0",
+                     requests={"cpu": 1500})
+    assert res.status == "draining"
+    assert svc.drain() is True
+    assert svc.drained_clean and svc.stopped
+    assert svc.ingest.depth() == 0
+    assert "default/late" not in d.workloads
+
+
+# ---------------------------------------------------------------------------
+# Durable ingest journal
+# ---------------------------------------------------------------------------
+
+def test_ingest_journal_roundtrip(tmp_path):
+    path = str(tmp_path / "j.ing")
+    j = IngestJournal(path)
+    s1 = j.accept("t1", {"name": "a"})
+    s2 = j.accept("t2", {"name": "b"})
+    s3 = j.accept("t3", {"name": "c"})
+    j.shed(s2, "t2")
+    j.mark_applied(s1, cycle=0)
+    j.close()
+    back = IngestJournal.load(path)
+    assert back.seq == 3
+    assert back.applied_upto == s1
+    assert back.shed_seqs == {s2}
+    assert [r["seq"] for r in back.unapplied()] == [s3]
+    # resume continues the sequence where the dead process stopped
+    cont = IngestJournal.resume(path)
+    assert cont.accept("t4", {"name": "d"}) == 4
+    cont.close()
+
+
+# ---------------------------------------------------------------------------
+# RateEWMA (the K chooser's arrival tracker)
+# ---------------------------------------------------------------------------
+
+def test_rate_ewma_primes_then_tracks():
+    e = RateEWMA(halflife_s=2.0)
+    assert e.update(10, 1.0) == 10.0     # cold start primes directly
+    for _ in range(20):
+        e.update(40, 1.0)
+    assert 35.0 < e.rate_per_s <= 40.0   # converged toward the new rate
+    with pytest.raises(ValueError):
+        RateEWMA(halflife_s=0.0)
+
+
+# ---------------------------------------------------------------------------
+# Registry thread safety (satellite audit)
+# ---------------------------------------------------------------------------
+
+def test_registry_concurrent_hammer():
+    """Counters, gauges, and histograms hammered from many threads
+    while another thread renders: exact totals, no lost updates, no
+    dict-mutation crashes."""
+    reg = Registry()
+    threads_n, per = 8, 500
+    errs = []
+    stop = threading.Event()
+
+    def render_loop():
+        try:
+            while not stop.is_set():
+                reg.render()
+        except Exception as e:           # pragma: no cover
+            errs.append(e)
+
+    def hammer(lane):
+        try:
+            for i in range(per):
+                reg.inc("kueue_admission_attempts_total", ("success",))
+                reg.set_gauge("kueue_svc_ingest_depth", (), float(i))
+                reg.add_gauge("kueue_svc_burst_window", (), 1.0)
+                reg.observe("kueue_svc_admission_latency_seconds", (),
+                            0.001 * (i % 7 + 1))
+        except Exception as e:           # pragma: no cover
+            errs.append(e)
+
+    rt = threading.Thread(target=render_loop)
+    rt.start()
+    ts = [threading.Thread(target=hammer, args=(k,))
+          for k in range(threads_n)]
+    for t in ts:
+        t.start()
+    for t in ts:
+        t.join()
+    stop.set()
+    rt.join()
+    assert errs == []
+    total = threads_n * per
+    assert reg.counters[
+        ("kueue_admission_attempts_total", "success")] == total
+    assert reg.gauges[("kueue_svc_burst_window",)] == float(total)
+    h = reg.histograms[("kueue_svc_admission_latency_seconds",)]
+    assert h.n == total
+
+
+def test_service_metrics_rendered():
+    d, clock = build()
+    svc = mk_service(d, clock)
+    svc.submit(name="w0", queue_name="lq-0", requests={"cpu": 1500})
+    svc.step()
+    text = d.metrics.render()
+    assert 'kueue_svc_submissions_total{result="accepted"} 1' in text
+    assert "kueue_svc_admission_latency_seconds_count" in text
+    assert "kueue_svc_burst_window 1" in text
+
+
+# ---------------------------------------------------------------------------
+# Env flags (satellite registration guard)
+# ---------------------------------------------------------------------------
+
+def test_service_env_flags_registered():
+    from kueue_tpu.features import ENV_FLAGS, env_int
+    for flag in ("KUEUE_TPU_SVC_HIGH_WATER", "KUEUE_TPU_SVC_SLO_P99_S",
+                 "KUEUE_TPU_SVC_DRAIN_TIMEOUT_S",
+                 "KUEUE_TPU_SVC_INGEST_JOURNAL", "KUEUE_TPU_SVC_SEED"):
+        assert flag in ENV_FLAGS
+    assert env_int("KUEUE_TPU_SVC_HIGH_WATER") > 0
+    # config resolution reads the registered defaults
+    cfg = ServiceConfig().resolved()
+    assert cfg.high_water == env_int("KUEUE_TPU_SVC_HIGH_WATER")
+    assert cfg.slo_p99_s > 0 and cfg.drain_timeout_s > 0
+
+
+# ---------------------------------------------------------------------------
+# Serving HTTP surface on VisibilityServer
+# ---------------------------------------------------------------------------
+
+def _post(url, body):
+    req = urllib.request.Request(
+        url, data=json.dumps(body).encode(), method="POST",
+        headers={"Content-Type": "application/json"})
+    with urllib.request.urlopen(req, timeout=5) as resp:
+        return resp.status, json.loads(resp.read())
+
+
+def test_visibility_serving_endpoints():
+    d, clock = build()
+    svc = mk_service(d, clock, high_water=2)
+    server = VisibilityServer(d, admission=svc)
+    port = server.start()
+    base = f"http://127.0.0.1:{port}/apis/serving/v1"
+    try:
+        code, body = _post(f"{base}/submit",
+                           {"name": "v0", "queue_name": "lq-0",
+                            "requests": {"cpu": 1500}})
+        assert code == 200 and body["status"] == "accepted"
+        tok = body["token"]
+        pos = json.loads(urllib.request.urlopen(
+            f"{base}/position?token={tok}", timeout=5).read())
+        assert pos["status"] == "pending" and pos["position"] == 0
+        pend = json.loads(urllib.request.urlopen(
+            f"{base}/pending", timeout=5).read())
+        assert pend["ingest_depth"] == 1
+        assert pend["items"][0]["token"] == tok
+        # fill to the high-water mark → HTTP backpressure is a 429
+        # carrying Retry-After
+        _post(f"{base}/submit", {"name": "v1", "queue_name": "lq-1",
+                                 "requests": {"cpu": 1500}})
+        with pytest.raises(urllib.error.HTTPError) as ei:
+            _post(f"{base}/submit", {"name": "v2", "queue_name": "lq-0",
+                                     "requests": {"cpu": 1500}})
+        assert ei.value.code == 429
+        assert ei.value.headers.get("Retry-After") is not None
+        svc.step()
+        stats = json.loads(urllib.request.urlopen(
+            f"{base}/stats", timeout=5).read())
+        assert stats["admitted"] == 2
+        metrics = urllib.request.urlopen(
+            f"http://127.0.0.1:{port}/metrics", timeout=5).read().decode()
+        assert "kueue_svc_submissions_total" in metrics
+        with pytest.raises(urllib.error.HTTPError):
+            urllib.request.urlopen(f"{base}/nope", timeout=5)
+    finally:
+        server.stop()

@@ -95,6 +95,26 @@ def test_chaos_soak_quick(tmp_path):
     assert _validate(out) == []
 
 
+def test_serve_soak_quick(tmp_path):
+    """The admission service end to end at smoke scale: wall-clock SLO
+    hold with online K adaptation, kill/restart convergence against an
+    unkilled control, SIGTERM drain, and batch-runner decision parity."""
+    out = str(tmp_path / "SERVE_r99.json")
+    d = _run_quick("serve_soak.py", out)
+    assert d["quick"] is True
+    assert d["all_ok"] is True
+    assert d["parity"]["decisions_identical"] is True
+    assert d["kill_restart"]["lost_accepted_submissions"] == 0
+    assert d["kill_restart"]["duplicated_admissions"] == 0
+    assert d["kill_restart"]["decisions_identical"] is True
+    assert d["kill_restart"]["digests_match"] is True
+    assert d["drain"]["clean"] is True
+    assert d["drain"]["wal_flushed"] is True
+    assert d["wall"]["slo"]["held"] is True
+    assert d["wall"]["slo"]["k_adapted"] is True
+    assert _validate(out) == []
+
+
 def test_obs_soak_quick(tmp_path):
     """The telemetry plane end to end at smoke scale: interleaved
     traced/untraced arms on identically-built drivers, bit-identical
