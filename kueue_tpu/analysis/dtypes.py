@@ -39,6 +39,10 @@ PLANE_SCHEMA: dict[str, str] = {
     "adm_usage0": "int32", "adm_uses0": "bool", "death0": "int32",
     # arena extras
     "u_cq0": "int32", "keys_grid": "object",
+    # cohort-forest aggregate planes (ops/aggregate.py)
+    "agg_heads": "int32", "agg_rows": "int32", "agg_comp": "int32",
+    "agg_comp_ts": "float64", "agg_best_prio": "int32",
+    "agg_best_ts": "float64",
     # tightenable structure planes
     "parent": "int32", "node_level": "int32", "nominal_cq": "int32",
     "slot_fr": "int32", "forest_of_cq": "int32", "members": "int32",

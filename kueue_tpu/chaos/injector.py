@@ -31,6 +31,10 @@ site                            effect at the call point
                                 file is written and fsynced but the atomic
                                 rename has not happened (recovery reads
                                 the old, uncompacted journal)
+``wal.shard_merge``             crash between per-segment compactions of a
+                                sharded WAL: segments sit at mixed
+                                compaction generations and the seq-merged
+                                replay must still converge
 ``shard.device_loss``           drop ``payload`` devices from the burst mesh
                                 (re-partition over the survivors)
 ``journal.drop_touch``          eat a PackJournal ``touch`` (lost update;

@@ -132,6 +132,7 @@ class Driver:
         self._burst_pack_state = None  # persistent delta-pack records
         self._wal = None            # write-ahead cycle journal (CycleWAL)
         self._bulk_applied_cqs = None  # non-None inside bulk_apply()
+        self._cycle_touched = None  # non-None inside cycle_apply()
         # CQs whose interrupted-cycle decision was recovered from the
         # WAL tail: they sit out the first post-recovery cycle so the
         # completed cycle matches the uncrashed one decision-for-decision
@@ -259,6 +260,41 @@ class Driver:
                     cq = self.cache.cluster_queue(name)
                     if cq is not None:
                         self.metrics.cluster_queue_status(name, cq.active)
+        return _ctx()
+
+    def cycle_apply(self):
+        """Context manager batching ONE burst cycle's decision patches:
+        every evict/finish inside the block records its CQ instead of
+        walking the cohort subtree for an inadmissible requeue, and the
+        cache's quota-tree rebuild is deferred — so a cycle with D
+        decisions costs one deduped ``queue_inadmissible_workloads``
+        pass and one cache settle instead of D of each.  Safe on the
+        burst apply path only: the cycle's heads and modeled decisions
+        are fixed before the block, and the next cycle's heads are read
+        after exit, so the deferred wakeups land at exactly the same
+        observable point (the next heads read) as the eager ones.
+        Opt-out: ``KUEUE_TPU_CYCLE_BULK_APPLY=0`` makes this a no-op
+        passthrough to the classic per-decision path."""
+        from contextlib import contextmanager
+
+        @contextmanager
+        def _ctx():
+            from ..features import env_value
+            if (env_value("KUEUE_TPU_CYCLE_BULK_APPLY") == "0"
+                    or self._cycle_touched is not None):
+                yield self
+                return
+            self._cycle_touched = []
+            try:
+                with self.cache.deferred_rebuild():
+                    yield self
+            finally:
+                touched, self._cycle_touched = self._cycle_touched, None
+            if touched:
+                seen: set = set()
+                names = [n for n in touched
+                         if not (n in seen or seen.add(n))]
+                self.queues.queue_inadmissible_workloads(names)
         return _ctx()
 
     def _drain_cluster_queue(self, cq_name: str) -> None:
@@ -447,7 +483,10 @@ class Driver:
             self.events.append(("Finished", key, message))
             any_done = True
         if touched:
-            self.queues.queue_inadmissible_workloads(touched)
+            if self._cycle_touched is not None:
+                self._cycle_touched.extend(touched)
+            else:
+                self.queues.queue_inadmissible_workloads(touched)
         if any_done:
             self.wake_gate_blocked()
         if self._wal is not None:
@@ -614,7 +653,10 @@ class Driver:
             self.queues.add_or_update_workload(wl)
             self.obs.emit("requeue", wl.key, cq_name, reason)
         if cq_name:
-            self.queues.queue_inadmissible_workloads([cq_name])
+            if self._cycle_touched is not None:
+                self._cycle_touched.append(cq_name)
+            else:
+                self.queues.queue_inadmissible_workloads([cq_name])
         self.wake_gate_blocked()   # evicting a not-ready blocker opens the gate
 
     def refresh_resource_metrics(self) -> None:
@@ -1183,40 +1225,54 @@ class Driver:
                     # empty cycle: pending finishes may unpark work
                     normal_cycle(heads=[], advance=False)
                     continue
-                with _span("burst.apply"):
-                    stats = self.scheduler.apply_burst_cycle(heads, modeled)
+                # one settle per cycle: evict/finish wakeups inside the
+                # block collapse into a single deduped requeue pass at
+                # exit — before the next heads read, so the observable
+                # order matches the eager path decision-for-decision
+                with self.cycle_apply():
+                    with _span("burst.apply"):
+                        stats = self.scheduler.apply_burst_cycle(heads,
+                                                                 modeled)
+                    if stats is not None:
+                        if has_pre_kind:
+                            bstats["burst_preempt_cycles"] += 1
+                        self.metrics.admission_attempt(
+                            bool(stats.admitted), stats.duration_s)
+                        if stats.admitted:
+                            # the ACTUAL reservation timestamps just
+                            # recorded — a resampled clock could tick
+                            # between two same-ts admissions and hide
+                            # the tie
+                            cycle_ts = [
+                                t for k2 in stats.admitted
+                                if (t := _reservation_ts(k2)) is not None]
+                            lo = min(cycle_ts, default=None)
+                            if (lo is not None
+                                    and last_adm_clock is not None
+                                    and lo <= last_adm_clock):
+                                clock_monotone = False
+                            if len(set(cycle_ts)) > 1:
+                                # >1 distinct timestamp inside ONE
+                                # cycle: the clock ticked mid-admission,
+                                # so modeled preempt ordering can no
+                                # longer mirror the host's
+                                # candidatesOrdering tie-break
+                                clock_monotone = False
+                            hi = max(cycle_ts, default=None)
+                            if hi is not None:
+                                last_adm_clock = (
+                                    hi if last_adm_clock is None
+                                    else max(last_adm_clock, hi))
+                        finish_cycle(stats)
                 if stats is None:
                     # a modeled preempt target has no live admitted
                     # counterpart: the model and the real state diverged
                     # — abandon the window and re-decide on the host
+                    # (outside cycle_apply: the host cycle must see the
+                    # eagerly-settled queue state)
                     bstats["burst_target_divergences"] += 1
                     normal_cycle(heads=heads, advance=False)
                     break
-                if has_pre_kind:
-                    bstats["burst_preempt_cycles"] += 1
-                self.metrics.admission_attempt(bool(stats.admitted),
-                                               stats.duration_s)
-                if stats.admitted:
-                    # the ACTUAL reservation timestamps just recorded —
-                    # a resampled clock could tick between two same-ts
-                    # admissions and hide the tie
-                    cycle_ts = [t for k2 in stats.admitted
-                                if (t := _reservation_ts(k2)) is not None]
-                    lo = min(cycle_ts, default=None)
-                    if (lo is not None and last_adm_clock is not None
-                            and lo <= last_adm_clock):
-                        clock_monotone = False
-                    if len(set(cycle_ts)) > 1:
-                        # >1 distinct timestamp inside ONE cycle: the
-                        # clock ticked mid-admission, so modeled preempt
-                        # ordering can no longer mirror the host's
-                        # candidatesOrdering tie-break
-                        clock_monotone = False
-                    hi = max(cycle_ts, default=None)
-                    if hi is not None:
-                        last_adm_clock = (hi if last_adm_clock is None
-                                          else max(last_adm_clock, hi))
-                finish_cycle(stats)
                 applied += 1
                 normal_streak = 0
                 dirty_backoff = 0
@@ -1280,7 +1336,13 @@ class Driver:
                 if uv is None:
                     return False
                 ext_release[k, ci] += uv[0]
-                ext_unpark[k, int(plan.arrays["forest_of_cq"][ci])] = True
+                if uv[0].any():
+                    # zero-usage finishes release nothing the kernel
+                    # can observe (matches the death-row path, which
+                    # only unparks on released usage); their wakeup
+                    # reaches the host through the heads-mismatch break
+                    ext_unpark[k,
+                               int(plan.arrays["forest_of_cq"][ci])] = True
         return True
 
     def run(self, stop_event, heads_timeout: float = 0.2) -> None:
@@ -1370,8 +1432,21 @@ class Driver:
                 "pack_arena_used_bytes", "pack_tighten_bytes_saved",
                 "pack_tighten_widened", "burst_launch_bytes_h2d")
                 if k in bs}
+            # cohort-forest compression block: packed vs compressed
+            # admitted rows + the compressible-CQ census (kueue_agg_*)
+            agg = {k: bs[k] for k in (
+                "agg_rows_compressed", "agg_rows_packed", "agg_heads",
+                "agg_cqs_compressible") if k in bs}
+            if agg:
+                out["agg"] = agg
+        from ..utils.heap import REPAIR_STATS
+        out["heap_repair"] = dict(REPAIR_STATS)
         if self._wal is not None and hasattr(self._wal, "stats"):
             out["wal"] = dict(self._wal.stats)
+            if "wal_shards" in out["wal"]:
+                out["wal_shard"] = {
+                    "wal_shards": out["wal"]["wal_shards"],
+                    "wal_shard_skew": out["wal"]["wal_shard_skew"]}
         solver = self.scheduler.solver
         if solver is not None and hasattr(solver, "stats"):
             ss = solver.stats
@@ -1386,6 +1461,8 @@ class Driver:
         self.metrics.burst_solver_sample(out.get("burst"),
                                          out.get("flavor_walk"))
         self.metrics.pack_sample(out.get("pack"), out.get("wal"))
+        self.metrics.scale_opt_sample(out.get("agg"), out["heap_repair"],
+                                      out.get("wal_shard"))
         out["obs"] = self.obs.report()
         return out
 

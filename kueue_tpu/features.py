@@ -167,6 +167,19 @@ ENV_FLAGS: dict[str, EnvFlag] = {f.name: f for f in (
             "Durable ingest-journal path; empty = in-memory only."),
     EnvFlag("KUEUE_TPU_SVC_SEED", "1709", "int",
             "Seed for the serving soak."),
+    EnvFlag("KUEUE_TPU_AGG_PLANES", "1", "bool",
+            "Cohort-forest compression: keep admitted rows of "
+            "non-preempting forests out of the packed planes and track "
+            "them in per-CQ aggregates instead."),
+    EnvFlag("KUEUE_TPU_LAZY_HEAP", "1", "bool",
+            "Lazy heap repair: buffer pushes/updates and settle with "
+            "one amortized sift pass at the next ordered read."),
+    EnvFlag("KUEUE_TPU_CYCLE_BULK_APPLY", "1", "bool",
+            "Batch each burst cycle's decision patches into one "
+            "requeue-wakeup pass and one deferred cache rebuild."),
+    EnvFlag("KUEUE_TPU_WAL_SHARDS", "1", "int",
+            "CycleWAL segment count; >1 stripes group-commit across "
+            "that many journal files with merged total-order replay."),
 )}
 
 

@@ -298,6 +298,42 @@ class Registry:
                 if k in wal_stats:
                     self.set_gauge(gauge, (), float(wal_stats[k]))
 
+    def scale_opt_sample(self, agg_stats=None, heap_stats=None,
+                         wal_shard_stats=None) -> None:
+        """Publish the 1M-CQ scale-path telemetry: cohort-forest
+        aggregate compression (``kueue_agg_*``, ops/aggregate.py), lazy
+        heap repair (``kueue_heap_repair_*``, utils/heap.py), and
+        sharded WAL striping (``kueue_wal_shard_*``, utils/journal.py).
+        Sampled by ``Driver.stats`` like the pack/WAL series."""
+        agg_gauge_of = {
+            "agg_rows_compressed": "kueue_agg_rows_compressed",
+            "agg_rows_packed": "kueue_agg_rows_packed",
+            "agg_heads": "kueue_agg_heads",
+            "agg_cqs_compressible": "kueue_agg_cqs_compressible",
+        }
+        heap_gauge_of = {
+            "heap_repair_settles": "kueue_heap_repair_settles",
+            "heap_repair_deferred": "kueue_heap_repair_deferred",
+            "heap_repair_settled_items": "kueue_heap_repair_settled_items",
+            "heap_repair_bulk": "kueue_heap_repair_bulk",
+        }
+        shard_gauge_of = {
+            "wal_shards": "kueue_wal_shards",
+            "wal_shard_skew": "kueue_wal_shard_skew",
+        }
+        if agg_stats:
+            for k, gauge in agg_gauge_of.items():
+                if k in agg_stats:
+                    self.set_gauge(gauge, (), float(agg_stats[k]))
+        if heap_stats:
+            for k, gauge in heap_gauge_of.items():
+                if k in heap_stats:
+                    self.set_gauge(gauge, (), float(heap_stats[k]))
+        if wal_shard_stats:
+            for k, gauge in shard_gauge_of.items():
+                if k in wal_shard_stats:
+                    self.set_gauge(gauge, (), float(wal_shard_stats[k]))
+
     def report_weighted_share(self, cq: str, share: float) -> None:
         self.set_gauge("kueue_cluster_queue_weighted_share", (cq,), share)
 
@@ -538,6 +574,27 @@ _SERIES_DEFS = [
      "WAL fsync calls."),
     ("kueue_wal_compactions", "gauge", (),
      "WAL checkpoint compactions."),
+    # 1M-CQ scale path: aggregate compression, lazy heap, WAL shards
+    ("kueue_agg_rows_compressed", "gauge", (),
+     "Admitted rows held as per-CQ aggregates instead of packed rows."),
+    ("kueue_agg_rows_packed", "gauge", (),
+     "Admitted rows materialized as packed kernel rows."),
+    ("kueue_agg_heads", "gauge", (),
+     "Pending heads tracked by the aggregate planes."),
+    ("kueue_agg_cqs_compressible", "gauge", (),
+     "CQs in non-preempting forests eligible for row compression."),
+    ("kueue_heap_repair_settles", "gauge", (),
+     "Lazy-heap settle passes (one per ordered read after mutations)."),
+    ("kueue_heap_repair_deferred", "gauge", (),
+     "Heap pushes/updates buffered by lazy repair."),
+    ("kueue_heap_repair_settled_items", "gauge", (),
+     "Buffered heap items applied during settle passes."),
+    ("kueue_heap_repair_bulk", "gauge", (),
+     "Settle passes that used the O(n) bulk heapify."),
+    ("kueue_wal_shards", "gauge", (),
+     "Configured CycleWAL segment count (1 = unsharded)."),
+    ("kueue_wal_shard_skew", "gauge", (),
+     "Max-minus-min appended ops across WAL segments."),
     # observability plane (obs/)
     ("kueue_span_duration_seconds", "histogram", ("phase",),
      "Traced hot-path phase durations (obs tracer), wall seconds."),

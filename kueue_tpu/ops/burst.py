@@ -1048,9 +1048,15 @@ class _CQRows:
     re-walks into a fresh record.  Row order within a record never
     reaches the plan — every stage-B rank comes from a total-order
     lexsort with a unique final tiebreak — so reuse stays bit-identical
-    even though a re-walk may enumerate members differently."""
+    even though a re-walk may enumerate members differently.
+
+    ``n_comp`` / ``comp_max_ts`` account for admitted rows of
+    compressible forests (ops/aggregate.py) that were walked but NOT
+    packed: their count and max reservation time are all the plan
+    needs from them (usage is already in ``u_row``)."""
     __slots__ = ("ci", "pos", "strict", "bad", "truncated",
-                 "n_pend", "n_adm", "keys", "uids", "prio", "ts",
+                 "n_pend", "n_adm", "n_comp", "comp_max_ts",
+                 "keys", "uids", "prio", "ts",
                  "res_ts", "parked", "ok", "resume", "adm", "req",
                  "usage", "uses", "u_row", "index_of_key", "infos")
 
@@ -1068,7 +1074,7 @@ class _PackStatics:
     __slots__ = ("forest_of_cq", "node_level", "n_levels", "L",
                  "members", "deep", "wcq_lower", "rwc_enabled",
                  "rwc_only_lower", "modelable_base", "potential0",
-                 "cand_tables")
+                 "comp_cq", "cand_tables")
 
 
 def _pack_statics(st, cache) -> _PackStatics:
@@ -1128,6 +1134,8 @@ def _pack_statics(st, cache) -> _PackStatics:
     s.rwc_enabled = rwc_enabled
     s.rwc_only_lower = rwc_only_lower
     s.modelable_base = modelable_base
+    from .aggregate import compressible_cqs
+    s.comp_cq = compressible_cqs(s)
     s.potential0 = np.minimum(available_all_np(
         np.zeros((N, F), np.int64), st.subtree_quota, st.guaranteed,
         st.borrow_cap, st.has_borrow_limit, st.parent, st.depth),
@@ -1150,11 +1158,17 @@ def _unknown_active_cq(st, queues) -> bool:
 
 
 def _pack_cq_rows(st, ci, pos, queues, cache, scheduler, assumed,
-                  scale_of, window):
+                  scale_of, window, compress=False):
     """Stage A for ONE ClusterQueue: walk its heap + parking lot and
     its admitted table into a _CQRows record, or _PACK_FAIL when the CQ
     can't be represented (missing from the cache, inexact usage
-    scaling)."""
+    scaling).
+
+    With ``compress`` (CQ in a compressible forest + aggregate planes
+    on) the admitted walk runs identically — same bad-detection, same
+    usage-vector check, so ``rec.bad`` matches the uncompressed arm
+    byte for byte — but representable admitted rows are folded into
+    ``n_comp`` / ``comp_max_ts`` aggregates instead of packed rows."""
     from ..api.types import (QueueingStrategy, AdmissionCheckState,
                              WL_EVICTED, WL_QUOTA_RESERVED)
     from .packing import scaled_usage_row
@@ -1176,6 +1190,8 @@ def _pack_cq_rows(st, ci, pos, queues, cache, scheduler, assumed,
     rec.pos = pos
     rec.bad = False
     rec.truncated = False
+    rec.n_comp = 0
+    rec.comp_max_ts = -np.inf
 
     q = queues.queue_for(cq_name)
     active = q is not None and q.active
@@ -1287,11 +1303,6 @@ def _pack_cq_rows(st, ci, pos, queues, cache, scheduler, assumed,
     rec.n_pend = i
 
     for info in admitted:
-        row = getattr(info, "_burst_row", None)
-        if row is None or row[0] != gen or row[1] != covers_pods:
-            row = (gen, *_static_row(info, st, covers_pods, qts))
-            info._burst_row = row
-        _, _, req_vec, static_ok, ts, prio, uid = row
         uv = admitted_usage_vec(info, st, scale_of, F)
         if uv is None:
             # not representable as a target/release row: the host
@@ -1299,6 +1310,22 @@ def _pack_cq_rows(st, ci, pos, queues, cache, scheduler, assumed,
             # its finish via the ext_release path
             rec.bad = True
             continue
+        if compress:
+            # never candidate-gathered (no preempting CQ in this
+            # forest): fold into the aggregates; a mid-burst finish
+            # reaches the kernel via the ext_release fallback exactly
+            # as an unpacked key does today
+            rec.n_comp += 1
+            ts_r = info.obj.conditions[WL_QUOTA_RESERVED] \
+                .last_transition_time
+            if ts_r > rec.comp_max_ts:
+                rec.comp_max_ts = ts_r
+            continue
+        row = getattr(info, "_burst_row", None)
+        if row is None or row[0] != gen or row[1] != covers_pods:
+            row = (gen, *_static_row(info, st, covers_pods, qts))
+            info._burst_row = row
+        _, _, req_vec, static_ok, ts, prio, uid = row
         key_l.append(info.key)
         uid_l.append(uid)
         prio_l.append(prio)
@@ -1360,11 +1387,16 @@ def _walk_records(st, queues, cache, scheduler, window):
     assumed = cache.assumed_workloads
     scale_of = {r: int(st.resource_scale[i])
                 for i, r in enumerate(st.resource_names)}
+    from .aggregate import agg_planes_enabled
+    s = _pack_statics(st, cache)
+    comp_cq = s.comp_cq if agg_planes_enabled() else None
     records = []
     for ci in range(C):
         rec = _pack_cq_rows(st, ci, pos_of.get(st.cq_names[ci], C),
                             queues, cache, scheduler, assumed,
-                            scale_of, window)
+                            scale_of, window,
+                            compress=(comp_cq is not None
+                                      and bool(comp_cq[ci])))
         if rec is _PACK_FAIL:
             return None
         records.append(rec)
@@ -1602,11 +1634,19 @@ def _assemble_plan(st, records, cache, scheduler, min_m,
         rwc_only_lower=s.rwc_only_lower, preempt_ok=preempt_ok,
         members=s.members, cand_rows=cand_rows, cand_lmem=cand_lmem,
         self_lmem=self_lmem)
+    # max_res_ts feeds the driver's admission-clock monotonicity check,
+    # so it must cover aggregate-compressed admitted rows too (their
+    # reservation times are real; only their packed rows are elided)
+    max_res_ts = float(res_ts_a[adm_a].max()) if adm_a.any() else None
+    comp_max = max((r.comp_max_ts for r in records if r.n_comp),
+                   default=None)
+    if comp_max is not None:
+        max_res_ts = (comp_max if max_res_ts is None
+                      else max(max_res_ts, comp_max))
     return BurstPlan(structure=st, arrays=arrays, keys=keys,
                      C=C, M=M, L=L, G=G, n_levels=s.n_levels, KC=KC,
                      seq_base=seq_base, row_of_key=row_of_key,
-                     max_res_ts=(float(res_ts_a[adm_a].max())
-                                 if adm_a.any() else None))
+                     max_res_ts=max_res_ts)
 
 
 def pack_burst(structure, queues, cache, scheduler, clock,
@@ -1760,8 +1800,9 @@ def _pack_burst_cached_classic(structure, queues, cache, scheduler,
             force_full |= j.drain_into(dirty, soft, row_of=st.cq_index,
                                        ranges_out=jranges)
     enabled = os.environ.get("KUEUE_BURST_DELTA_PACK", "1") != "0"
+    from .aggregate import agg_planes_enabled
     key = (st.generation, st.resource_scale.tobytes(),
-           tuple(st.cq_names), window)
+           tuple(st.cq_names), window, agg_planes_enabled())
 
     def _full():
         if _unknown_active_cq(st, queues):

@@ -46,6 +46,7 @@ from typing import Optional
 import numpy as np
 
 from ..cache.arena import PlaneArena
+from . import aggregate as _agg
 from . import burst as _b
 from .packing import _bucket
 
@@ -178,6 +179,7 @@ class StreamState:
                  "mi_of", "kb_of",
                  "n_rows_cq", "n_pend_cq", "maxabs_prio_cq", "bad_cq",
                  "strict_cq", "pos_cq", "cq_names_list",
+                 "n_comp_cq", "comp_max_cq",
                  "row_of_key", "keys_grid", "M")
 
     def __init__(self, key, arena):
@@ -195,13 +197,28 @@ def _views(arena: PlaneArena, C: int, M: int, R: int, F: int) -> dict:
         out[name] = arena.ensure(name, shape, dt, pad)
     out["u_cq0"] = arena.ensure("u_cq0", (C, F), np.int32, 0, grow_axes=1)
     out["keys_grid"] = arena.ensure("keys_grid", (C, M), object, None)
+    out["agg_heads"] = arena.ensure("agg_heads", (C,), np.int32, 0)
+    out["agg_rows"] = arena.ensure("agg_rows", (C,), np.int32, 0)
+    out["agg_comp"] = arena.ensure("agg_comp", (C,), np.int32, 0)
+    out["agg_comp_ts"] = arena.ensure("agg_comp_ts", (C,),
+                                      np.float64, -1.0)
+    out["agg_best_prio"] = arena.ensure("agg_best_prio", (C,),
+                                        np.int32, 0)
+    out["agg_best_ts"] = arena.ensure("agg_best_ts", (C,),
+                                      np.float64, -1.0)
     return out
 
 
 def _reset_views(views: dict) -> None:
     for name, v in views.items():
-        pad = None if name == "keys_grid" else \
-            0 if name == "u_cq0" else _ROW_PLANES[name][0]
+        if name == "keys_grid":
+            pad = None
+        elif name == "u_cq0":
+            pad = 0
+        elif name in _agg.AGG_PLANES:
+            pad = _agg.AGG_PLANES[name][0]
+        else:
+            pad = _ROW_PLANES[name][0]
         base = v
         while base.base is not None:
             base = base.base
@@ -220,6 +237,7 @@ def _clear_cq(state: "StreamState", views: dict, ci: int) -> None:
             base = base.base
         base[ci] = pad
     views["u_cq0"][ci] = 0
+    _agg.agg_clear_cq(views, ci)
     kg = views["keys_grid"]
     base = kg
     while base.base is not None:
@@ -253,6 +271,7 @@ def _write_cq(state: "StreamState", views: dict, ci: int, rec,
         for k, m in zip(keys, mi.tolist()):
             row_of[k] = (ci, int(m))
     views["u_cq0"][ci] = rec.u_row
+    _agg.agg_write_cq(views, ci, rec)
 
 
 def _cq_mi(rec) -> np.ndarray:
@@ -278,9 +297,13 @@ def _row_patch_job(state, st, queues, cache, scheduler, ci, key):
     rec = state.records[ci]
     idx = rec.index_of_key.get(key)
     if idx is None:
-        # below a window-truncation cutoff is the only benign absence
-        # (and the row-grade bits of an unpacked row can't matter)
-        return None if rec.truncated else _ESCALATE
+        # benign absences: below a window-truncation cutoff, or an
+        # aggregate-compressed admitted row (its only row-grade bit,
+        # vec_ok, never reaches the kernel — no candidates are drawn
+        # from a compressible forest).  Membership changes always come
+        # through hard journal touches, which dirty the CQ before row
+        # jobs run, so an unknown key here can't be a new workload.
+        return None if (rec.truncated or rec.n_comp) else _ESCALATE
     cq_name = st.cq_names[ci]
     q = queues.queue_for(cq_name)
     cq_live = cache.cluster_queue(cq_name)
@@ -368,7 +391,10 @@ def _materialize(st, state, s, views, scheduler, dirty_cis, prev_token,
     n = int(state.n_rows_cq.sum())
     L, G = s.L, st.n_forests
     KC = min(_b.KC_CAP, ((L * M + 31) // 32) * 32)
-    # seq_base / max_res_ts from the maintained admitted-ts multiset
+    # seq_base / max_res_ts from the maintained admitted-ts multiset;
+    # max_res_ts (the driver's admission clock) must also cover
+    # aggregate-compressed admitted rows, whose reservation times live
+    # only in the per-CQ comp_max_cq aggregate
     if len(state.adm_ts):
         uniq = np.unique(state.adm_ts)
         seq_base = int(len(uniq)) + 2
@@ -376,6 +402,10 @@ def _materialize(st, state, s, views, scheduler, dirty_cis, prev_token,
     else:
         seq_base = 2
         max_res_ts = None
+    comp_max = float(state.comp_max_cq.max(initial=-np.inf))
+    if np.isfinite(comp_max):
+        max_res_ts = (comp_max if max_res_ts is None
+                      else max(max_res_ts, comp_max))
     forest_bad = s.deep.copy()
     bad_idx = np.nonzero(state.bad_cq)[0]
     if len(bad_idx):
@@ -434,6 +464,7 @@ def _materialize(st, state, s, views, scheduler, dirty_cis, prev_token,
         state.arena.refresh_stats(shapes)
         stats.update({("pack_" + k): v
                       for k, v in state.arena.stats.items()})
+        stats.update(_agg.agg_summary(state, s.comp_cq))
     return plan
 
 
@@ -501,6 +532,10 @@ def _init_full(st, queues, cache, scheduler, key, min_m, window, arena,
                                   np.int64, C)
     state.bad_cq = np.fromiter((r.bad for r in records), bool, C)
     state.strict_cq = np.fromiter((r.strict for r in records), bool, C)
+    state.n_comp_cq = np.fromiter((r.n_comp for r in records),
+                                  np.int64, C)
+    state.comp_max_cq = np.fromiter((r.comp_max_ts for r in records),
+                                    np.float64, C)
     bounds = np.concatenate(([0], np.cumsum(state.n_rows_cq)))
     n = int(bounds[-1])
 
@@ -571,6 +606,7 @@ def _init_full(st, queues, cache, scheduler, key, min_m, window, arena,
         state.row_of_key = {}
     for ci, rec in enumerate(records):
         views["u_cq0"][ci] = rec.u_row
+    _agg.agg_fill(views, records)
 
     # maintained global orders + their dense rank planes
     state.crank = _Order(_SKEY_S)
@@ -630,7 +666,7 @@ def pack_burst_streaming(structure, queues, cache, scheduler, clock,
     st = structure
     t0 = time.perf_counter()
     key = (st.generation, st.resource_scale.tobytes(),
-           tuple(st.cq_names), window)
+           tuple(st.cq_names), window, _agg.agg_planes_enabled())
     dirty: set = set()
     soft: dict = {}
     rows: dict = {}
@@ -712,6 +748,9 @@ def pack_burst_streaming(structure, queues, cache, scheduler, clock,
         assumed = cache.assumed_workloads
         scale_of = {r: int(st.resource_scale[i])
                     for i, r in enumerate(st.resource_names)}
+        statics = _b._pack_statics(st, cache)
+        comp_cq = (statics.comp_cq if _agg.agg_planes_enabled()
+                   else None)
         walked = []
         for name in dirty:
             ci = index_of.get(name)
@@ -719,7 +758,9 @@ def pack_burst_streaming(structure, queues, cache, scheduler, clock,
                 continue
             rec = _b._pack_cq_rows(st, ci, int(state.pos_cq[ci]),
                                    queues, cache, scheduler, assumed,
-                                   scale_of, window)
+                                   scale_of, window,
+                                   compress=(comp_cq is not None
+                                             and bool(comp_cq[ci])))
             if rec is _b._PACK_FAIL:
                 return None, None, False
             kb = _enc_str(rec.keys, _KEY_BYTES)
@@ -731,6 +772,8 @@ def pack_burst_streaming(structure, queues, cache, scheduler, clock,
             state.n_pend_cq[ci] = rec.n_pend
             state.bad_cq[ci] = rec.bad
             state.strict_cq[ci] = rec.strict
+            state.n_comp_cq[ci] = rec.n_comp
+            state.comp_max_cq[ci] = rec.comp_max_ts
             state.maxabs_prio_cq[ci] = int(
                 np.abs(rec.prio).max(initial=0))
         rows_per_cq = int(state.n_rows_cq.max(initial=0))

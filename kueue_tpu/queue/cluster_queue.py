@@ -12,6 +12,7 @@ import enum
 from typing import Callable, Optional
 
 from ..api.types import ConditionStatus, QueueingStrategy, WL_REQUEUED
+from ..features import env_value
 from ..utils.heap import Heap
 from ..workload import Info, Ordering
 
@@ -43,8 +44,13 @@ class ClusterQueueQueue:
         self.queueing_strategy = strategy
         self.ordering = ordering
         self.clock = clock
-        self.heap: Heap[Info] = Heap(key_fn=lambda i: i.key,
-                                     less=queue_ordering_less(ordering))
+        # lazy repair defers decision-storm pushes to one settle pass
+        # at the next heads read; pop/peek order is identical to eager
+        # (strict total order via the key tiebreak, test-enforced)
+        self.heap: Heap[Info] = Heap(
+            key_fn=lambda i: i.key,
+            less=queue_ordering_less(ordering),
+            lazy=env_value("KUEUE_TPU_LAZY_HEAP") != "0")
         self.inadmissible: dict[str, Info] = {}
         self.inflight: Optional[Info] = None
         self.pop_cycle = 0

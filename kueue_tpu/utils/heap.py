@@ -1,7 +1,20 @@
-"""Key-deduplicating binary heap.
+"""Key-deduplicating binary heap, with optional lazy repair.
 
 Capability parity with reference pkg/util/heap: items are keyed; pushing an
 existing key updates it in place and re-sifts; delete by key is O(log n).
+
+Lazy mode (``lazy=True``, wired to ``KUEUE_TPU_LAZY_HEAP`` by the
+cluster-queue layer) buffers ``push_or_update`` into a pending dict and
+repairs the heap with ONE amortized pass at the next *ordered* read
+(``peek``/``pop``).  Unordered reads — ``get``/``keys``/``items``/
+``delete``/``len`` — are answered from the pending overlay without
+settling, so a burst cycle's storm of requeues and deletes costs O(1)
+each and the sift work is paid once when the next cycle reads heads.
+Because the comparator is a strict total order (key tiebreak), the
+settled heap's peek/pop sequence is *provably identical* to eager
+repair: peek/pop always return the unique comparator-minimum of the
+same membership, whatever the internal array layout (property-tested
+in tests/test_lazy_heap.py).
 """
 
 from __future__ import annotations
@@ -10,28 +23,95 @@ from typing import Callable, Generic, Optional, TypeVar
 
 T = TypeVar("T")
 
+# process-wide lazy-repair counters (kueue_heap_repair_* metrics)
+REPAIR_STATS = {
+    "heap_repair_settles": 0,      # settle passes (one per ordered read
+    #                                after >=1 deferred mutation)
+    "heap_repair_deferred": 0,     # push/update ops buffered
+    "heap_repair_settled_items": 0,  # items applied during settles
+    "heap_repair_bulk": 0,         # settles that used O(n) heapify
+}
+
 
 class Heap(Generic[T]):
-    def __init__(self, key_fn: Callable[[T], str], less: Callable[[T, T], bool]):
+    def __init__(self, key_fn: Callable[[T], str],
+                 less: Callable[[T, T], bool], lazy: bool = False):
         self._key = key_fn
         self._less = less
         self._items: list[T] = []
         self._index: dict[str, int] = {}
+        self._lazy = lazy
+        self._pending: dict[str, T] = {}
+        self._pending_fresh = 0    # pending keys not already indexed
 
     def __len__(self) -> int:
-        return len(self._items)
+        return len(self._items) + self._pending_fresh
 
     def keys(self) -> list[str]:
-        return list(self._index)
+        if not self._pending:
+            return list(self._index)
+        return list(self._index) + [k for k in self._pending
+                                    if k not in self._index]
 
     def get(self, key: str) -> Optional[T]:
+        item = self._pending.get(key)
+        if item is not None:
+            return item
         idx = self._index.get(key)
         return self._items[idx] if idx is not None else None
 
     def items(self) -> list[T]:
-        return list(self._items)
+        if not self._pending:
+            return list(self._items)
+        pend = self._pending
+        return [it for it in self._items
+                if self._key(it) not in pend] + list(pend.values())
 
     def push_or_update(self, item: T) -> None:
+        if self._lazy:
+            key = self._key(item)
+            if key not in self._pending and key not in self._index:
+                self._pending_fresh += 1
+            self._pending[key] = item
+            REPAIR_STATS["heap_repair_deferred"] += 1
+            return
+        self._push_now(item)
+
+    def push_if_not_present(self, item: T) -> bool:
+        key = self._key(item)
+        if key in self._pending or key in self._index:
+            return False
+        self.push_or_update(item)
+        return True
+
+    def peek(self) -> Optional[T]:
+        self._settle()
+        return self._items[0] if self._items else None
+
+    def pop(self) -> Optional[T]:
+        self._settle()
+        if not self._items:
+            return None
+        top = self._items[0]
+        self._remove_at(0)
+        return top
+
+    def delete(self, key: str) -> bool:
+        removed = False
+        if key in self._pending:
+            del self._pending[key]
+            if key not in self._index:
+                self._pending_fresh -= 1
+            removed = True
+        idx = self._index.get(key)
+        if idx is not None:
+            self._remove_at(idx)
+            removed = True
+        return removed
+
+    # -- internals --
+
+    def _push_now(self, item: T) -> None:
         key = self._key(item)
         idx = self._index.get(key)
         if idx is not None:
@@ -43,30 +123,31 @@ class Heap(Generic[T]):
             self._index[key] = len(self._items) - 1
             self._sift_up(len(self._items) - 1)
 
-    def push_if_not_present(self, item: T) -> bool:
-        if self._key(item) in self._index:
-            return False
-        self.push_or_update(item)
-        return True
-
-    def peek(self) -> Optional[T]:
-        return self._items[0] if self._items else None
-
-    def pop(self) -> Optional[T]:
-        if not self._items:
-            return None
-        top = self._items[0]
-        self._remove_at(0)
-        return top
-
-    def delete(self, key: str) -> bool:
-        idx = self._index.get(key)
-        if idx is None:
-            return False
-        self._remove_at(idx)
-        return True
-
-    # -- internals --
+    def _settle(self) -> None:
+        """Apply the pending overlay in one amortized repair pass."""
+        pend = self._pending
+        if not pend:
+            return
+        self._pending = {}
+        self._pending_fresh = 0
+        REPAIR_STATS["heap_repair_settles"] += 1
+        REPAIR_STATS["heap_repair_settled_items"] += len(pend)
+        if len(pend) >= max(8, len(self._items) // 4):
+            # bulk: place every item, then one O(n) heapify — cheaper
+            # than len(pend) sifts when the overlay is a large fraction
+            REPAIR_STATS["heap_repair_bulk"] += 1
+            for key, item in pend.items():
+                idx = self._index.get(key)
+                if idx is not None:
+                    self._items[idx] = item
+                else:
+                    self._items.append(item)
+                    self._index[key] = len(self._items) - 1
+            for idx in range(len(self._items) // 2 - 1, -1, -1):
+                self._sift_down(idx)
+        else:
+            for item in pend.values():
+                self._push_now(item)
 
     def _remove_at(self, idx: int) -> None:
         key = self._key(self._items[idx])

@@ -73,6 +73,7 @@ from __future__ import annotations
 
 import json
 import os
+import zlib
 from typing import Optional
 
 from ..chaos import injector as _chaos
@@ -362,6 +363,7 @@ class CycleWAL:
                 os.fsync(out.fileno())
             self._fh.flush()
             self._fh.close()
+            self._fh = None   # a crash below must leave close() safe
             if _chaos.ACTIVE is not None:
                 # crash here leaves the old journal intact plus a stray
                 # .compact temp file: recovery reads the uncompacted log
@@ -427,6 +429,149 @@ class CycleWAL:
             if replay_op(store, op):
                 n += 1
         return n
+
+
+class ShardedCycleWAL:
+    """CycleWAL striped across K journal segments.
+
+    At high admission rates the single-file group commit serializes:
+    every cycle's ops funnel through one ``write``+``flush`` stream and
+    one fsync cadence.  This variant routes each op to one of K
+    ``CycleWAL`` segments by a *stable* hash of its workload key (CQ
+    shard affinity: one workload's ops always land in one segment), so
+    appends and group-commit flushes stripe across K files while a
+    process-global monotone ``seq`` stamped into every op preserves the
+    total order.  ``tail``/``replay_tail`` merge the per-segment tails
+    back into seq order, so recovery converges to the same state as the
+    unsharded journal byte for byte (crash-parity test-enforced at
+    every ``wal.*`` chaos site).
+
+    Duck-compatible with ``CycleWAL`` (``log``/``commit``/``tail``/
+    ``replay_tail``/``compact``/``close``/``stats``/``path``) —
+    ``Driver.attach_wal`` and ``recover_from`` take either.  Segment
+    files live at ``{path}.s00 .. .s{K-1:02d}``; ``load_cycle_wal``
+    autodetects them.  ``wal.shard_merge`` is the chaos crashpoint
+    between per-segment compactions: a crash there leaves segments at
+    mixed compaction generations, which the merged replay must absorb.
+    """
+
+    def __init__(self, path: Optional[str] = None, shards: int = 2,
+                 commit_every: Optional[int] = None,
+                 fsync: bool = False, compact_every: int = 0):
+        self.path = path
+        self.shards = max(2, int(shards))
+        self._shards = [
+            CycleWAL(self.shard_path(path, i) if path else None,
+                     commit_every=commit_every, fsync=fsync,
+                     compact_every=compact_every)
+            for i in range(self.shards)]
+        self._seq = 0
+
+    @staticmethod
+    def shard_path(path: str, i: int) -> str:
+        return f"{path}.s{i:02d}"
+
+    def _route(self, op: dict) -> int:
+        key = op.get("key") or (op.get("keys") or ("",))[0]
+        return zlib.crc32(key.encode("utf-8", "replace")) % self.shards
+
+    # -- writing --
+
+    def log(self, op: dict) -> None:
+        seq = self._seq
+        self._seq += 1
+        self._shards[self._route(op)].log(dict(op, seq=seq))
+
+    def commit(self) -> None:
+        for sh in self._shards:
+            sh.commit()   # no-op for segments with no open batch
+
+    def compact(self) -> int:
+        n = 0
+        for i, sh in enumerate(self._shards):
+            n += sh.compact()
+            if i == 0 and _chaos.ACTIVE is not None:
+                # crash between segment compactions: segments now sit
+                # at mixed generations; the seq-merged replay converges
+                _chaos.ACTIVE.crashpoint("wal.shard_merge")
+        return n
+
+    def close(self) -> None:
+        for sh in self._shards:
+            sh.close()
+
+    # -- reading --
+
+    @property
+    def tail(self) -> list[dict]:
+        """Union of segment tails, merged back into total (seq) order."""
+        ops = [op for sh in self._shards for op in sh.tail]
+        ops.sort(key=lambda op: op.get("seq", 0))
+        return ops
+
+    @property
+    def stats(self) -> dict:
+        out = {"wal_appends": 0, "wal_commits": 0, "wal_flushes": 0,
+               "wal_fsyncs": 0, "wal_compactions": 0}
+        appends = []
+        for sh in self._shards:
+            appends.append(sh.stats["wal_appends"])
+            for k in out:
+                out[k] += sh.stats[k]
+        out["wal_shards"] = self.shards
+        out["wal_shard_skew"] = max(appends) - min(appends)
+        return out
+
+    @classmethod
+    def load(cls, path: str) -> "ShardedCycleWAL":
+        """Rebuild from segment files (the recovery read path); like
+        ``CycleWAL.load`` the result carries no file handles."""
+        wal = cls.__new__(cls)
+        wal.path = path
+        wal._shards = []
+        i = 0
+        while os.path.exists(cls.shard_path(path, i)):
+            wal._shards.append(CycleWAL.load(cls.shard_path(path, i)))
+            i += 1
+        wal.shards = len(wal._shards)
+        wal._seq = 1 + max(
+            (op.get("seq", -1) for sh in wal._shards
+             for b in (sh.batches + [sh.tail]) for op in b),
+            default=-1)
+        return wal
+
+    # -- replay --
+
+    def replay_tail(self, store: dict) -> int:
+        n = 0
+        for op in self.tail:
+            if replay_op(store, op):
+                n += 1
+        return n
+
+
+def make_cycle_wal(path: Optional[str] = None,
+                   commit_every: Optional[int] = None,
+                   fsync: bool = False, compact_every: int = 0,
+                   shards: Optional[int] = None):
+    """WAL factory honoring ``KUEUE_TPU_WAL_SHARDS`` (1 = the classic
+    single-file CycleWAL; >1 = the striped variant)."""
+    if shards is None:
+        shards = env_int("KUEUE_TPU_WAL_SHARDS")
+    if shards <= 1:
+        return CycleWAL(path, commit_every=commit_every, fsync=fsync,
+                        compact_every=compact_every)
+    return ShardedCycleWAL(path, shards=shards,
+                           commit_every=commit_every, fsync=fsync,
+                           compact_every=compact_every)
+
+
+def load_cycle_wal(path: str):
+    """Recovery read path for either WAL layout: segment files beside
+    ``path`` mean it was sharded."""
+    if os.path.exists(ShardedCycleWAL.shard_path(path, 0)):
+        return ShardedCycleWAL.load(path)
+    return CycleWAL.load(path)
 
 
 # -- op encode/decode -------------------------------------------------------

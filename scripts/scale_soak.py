@@ -1,42 +1,68 @@
-"""Scale soak: the streaming delta-pack scaling law, 1k -> 100k CQs.
+"""Scale soak: the streaming delta-pack scaling law and the lifted
+row ceiling, 1k CQs -> the 2^19-row frontier.
 
-Publishes ``SCALE_r13.json``:
+Publishes ``SCALE_r18.json``:
 
-  curve   — per-universe-size (CQs 1k..100k) host pack cost for the
-            streaming arena vs a from-scratch rebuild measured on the
-            SAME live state at the SAME boundary (the rebuild therefore
-            doubles as the interleaved same-box control), plane-parity
-            verdicts (bytes-identical packed planes), bytes-to-device
-            before/after dtype tightening, end-to-end burst cycle wall
-            cost and decision A/B between the streaming and
-            rebuild-every-boundary drivers, and RSS;
-  soak    — a 10M-workload streaming run at the largest size with a
-            group-committed, auto-compacting CycleWAL attached:
-            workloads arrive, admit through the fused device path,
-            finish, and are deleted in rounds until the target count
-            has flowed through one box;
-  parity  — every probed size must report bytes-identical planes AND
-            bit-identical decisions between arms.
+  curve     — per-universe-size host pack cost for the streaming arena
+              vs a from-scratch rebuild measured on the SAME live state
+              at the SAME boundary (the rebuild doubles as the
+              interleaved same-box control), plane-parity verdicts,
+              bytes-to-device, end-to-end burst cycle wall and decision
+              A/B across THREE arms: streaming (all r18 optimizations
+              on), rebuild-every-boundary, and "classic" (aggregate
+              compression, lazy heap repair and cycle bulk apply all
+              off) — decisions must be bit-identical across all arms at
+              every probed size;
+  ceiling   — the lifted row cap, demonstrated: a universe whose LIVE
+              workload count crosses the kernel's 2^19 row budget while
+              the aggregate-compressed pack stays under it (the
+              row-backed pack does not), with the measured per-round
+              wall at that size;
+  aggregate — packed rows vs live rows per size with compression on vs
+              off, and the ``max_res_ts`` (clock-anchor) equality
+              verdicts;
+  heap      — lazy vs eager heap repair: per-cycle decision-apply cost
+              at 100k items across per-key touch rates, plus the
+              driver-level host apply+heap time, optimized vs classic;
+  wal_shard — sharded vs single-file CycleWAL append+group-commit wall
+              and the seq-merged replay-parity verdict;
+  soak      — a high-count streaming run at the largest size with the
+              (sharded) group-committed, auto-compacting CycleWAL
+              attached: workloads arrive, admit through the fused
+              device path, finish, and are deleted in rounds until the
+              target count has flowed through one box;
+  residues  — the r13 residue list (live-row cap, host-apply serial
+              cost, WAL group-commit serialization) with post-r18
+              status, mechanism, flag and measured evidence, plus the
+              walls that remain, named with measured numbers;
+  parity    — every probed size must report bytes-identical planes AND
+              bit-identical decisions between every pair of arms.
 
-The claim under test (ISSUE 11): host pack cost is O(arrivals + dirty
-rows), not O(universe) — the streaming arm's pack ms stays flat as CQs
-grow 100x while the rebuild arm grows linearly, >= 5x apart at 100k.
+The claims under test (ISSUE 16): kernel rows scale with active CQs +
+heads, not live workloads (the 2^19 budget stops capping live rows);
+the per-cycle host apply+heap cost drops >= 5x at 100k CQs via
+one-settle bulk apply + lazy heap repair; the sharded WAL removes the
+single group-commit stream; and every optimization is bit-identical to
+the classic path, per size, per cycle.
 
 Usage:
     python scripts/scale_soak.py [--sizes 1000,4000,...] [--seed N]
         [--boundaries N] [--rounds N] [--soak-workloads N]
-        [--quick] [--out SCALE_r13.json]
+        [--soak-cqs N] [--ceiling-cqs N] [--wal-shards K]
+        [--quick] [--out SCALE_r18.json]
 """
 
 from __future__ import annotations
 
 import argparse
 import gc
+import glob
 import json
 import os
 import random
 import sys
 import time
+from contextlib import contextmanager
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
@@ -56,10 +82,39 @@ from kueue_tpu.api.types import (
 )
 from kueue_tpu.controller.driver import Driver
 from kueue_tpu.features import env_value
+from kueue_tpu.obs import trace as _trace
 from kueue_tpu.ops.burst import pack_burst, pack_burst_cached
 from kueue_tpu.ops.packing import TightenState, tighten_arrays
 from kueue_tpu.perf.harness import ab_block
-from kueue_tpu.utils.journal import CycleWAL
+from kueue_tpu.utils.heap import Heap
+from kueue_tpu.utils.journal import (
+    CycleWAL,
+    ShardedCycleWAL,
+    load_cycle_wal,
+    make_cycle_wal,
+)
+
+#: the kernel's composite-key row budget (ops/burst.py: uid rank packs
+#: into 19 bits) — the ceiling this artifact is about
+ROW_BUDGET = 1 << 19
+
+_AGG_FLAG = "KUEUE_TPU_AGG_PLANES"
+
+
+@contextmanager
+def agg_planes_off():
+    """The row-backed control pack: aggregate compression forced off,
+    environment restored on exit."""
+    old = {k: os.environ.get(k) for k in (_AGG_FLAG,)}
+    os.environ[_AGG_FLAG] = "0"
+    try:
+        yield
+    finally:
+        for k, v in old.items():
+            if v is None:
+                os.environ.pop(k, None)
+            else:
+                os.environ[k] = v
 
 
 class VirtualClock:
@@ -225,9 +280,23 @@ def pack_curve_point(n_cqs: int, boundaries: int, n_churn: int,
                 for v in tighten_arrays(arrays, tight).values())
             rows = sum(1 for row in plan_s.keys
                        for k in row if k is not None)
+    # the row-backed control pack on the SAME final state: aggregate
+    # compression off, everything else identical — the packed-row
+    # shrink and the max_res_ts (clock-anchor) equality come from here
+    with agg_planes_off():
+        plan_row = pack_burst(st, d.queues, d.cache, d.scheduler, clock)
+    rows_row_backed = 0 if plan_row is None else sum(
+        1 for row in plan_row.keys for k in row if k is not None)
+    agg_max_ts_equal = (
+        (plan_s is None) == (plan_row is None)
+        and (plan_s is None or plan_s.max_res_ts == plan_row.max_res_ts))
     out = {
         "cqs": n_cqs,
         "rows": rows,
+        "live_rows": len(d.workloads),
+        "rows_row_backed": rows_row_backed,
+        "agg_rows_compressed": int(stats.get("agg_rows_compressed", 0)),
+        "agg_max_res_ts_equal": bool(agg_max_ts_equal),
         "boundaries": boundaries,
         "churn_cqs_per_boundary": n_churn,
         "pack_ms_stream": round(float(np.median(stream_ms)), 3),
@@ -262,21 +331,47 @@ _ARM_ENV = {
     "stream": {"KUEUE_TPU_STREAM_PACK": "1"},
     "rebuild": {"KUEUE_TPU_STREAM_PACK": "0",
                 "KUEUE_BURST_DELTA_PACK": "0"},
+    # the r18 bit-identity control: streaming pack on, every scale
+    # optimization off — aggregate compression, lazy heap repair and
+    # one-settle cycle bulk apply
+    "classic": {"KUEUE_TPU_STREAM_PACK": "1",
+                "KUEUE_TPU_AGG_PLANES": "0",
+                "KUEUE_TPU_LAZY_HEAP": "0",
+                "KUEUE_TPU_CYCLE_BULK_APPLY": "0"},
 }
+
+_ARM_KEYS = ("KUEUE_TPU_STREAM_PACK", "KUEUE_BURST_DELTA_PACK",
+             "KUEUE_TPU_AGG_PLANES", "KUEUE_TPU_LAZY_HEAP",
+             "KUEUE_TPU_CYCLE_BULK_APPLY")
+
+#: span phases that are pack or device work — everything else inside
+#: the timed wall is host decide+apply+heap+queue cost
+_KERNEL_SPANS = ("burst.pack", "burst.dispatch", "burst.fetch")
+
+
+def _span_totals(tracer) -> dict:
+    return {n: tracer._hist_for(n).total for n in _KERNEL_SPANS}
 
 
 def e2e_arm(arm: str, n_cqs: int, rounds: int, n_churn: int,
             seed: int) -> dict:
-    old = {k: os.environ.get(k) for k in
-           ("KUEUE_TPU_STREAM_PACK", "KUEUE_BURST_DELTA_PACK")}
+    old = {k: os.environ.get(k) for k in _ARM_KEYS}
+    for k in _ARM_KEYS:
+        os.environ.pop(k, None)
     os.environ.update(_ARM_ENV[arm])
     try:
         d, clock = build(n_cqs)
         preload(d, clock, n_cqs, seed)
+        # span tracing is decision-neutral (OBS artifact contract) and
+        # is enabled on every arm alike; the pack/dispatch/fetch span
+        # sums subtracted from the timed wall leave the per-cycle HOST
+        # apply+heap+queue cost the r18 bulk-apply stack targets
+        tracer = d.obs.enable_tracing()
         rng = random.Random(seed + 2)
         decisions = []
         n_cycles = 0
         wall = 0.0
+        base_spans = _span_totals(tracer)
         # round 0 is an untimed warmup: it absorbs the fused kernel's
         # JIT compiles (shape-dependent, cached process-wide) so the
         # timed rounds measure steady state — its DECISIONS still count
@@ -291,12 +386,19 @@ def e2e_arm(arm: str, n_cqs: int, rounds: int, n_churn: int,
             if r > 0:
                 wall += time.perf_counter() - t0
                 n_cycles += len(recs)
+            else:
+                base_spans = _span_totals(tracer)
             decisions.extend(
                 (sorted(s.admitted), sorted(s.skipped),
                  sorted(s.preempted_targets)) for s in recs)
+        spans = _span_totals(tracer)
+        kernel_s = sum(spans[n] - base_spans[n] for n in _KERNEL_SPANS)
+        host_apply_ms = round(
+            max(wall - kernel_s, 0.0) * 1e3 / max(n_cycles, 1), 3)
         bs = dict(d._burst_solver.stats) if d._burst_solver else {}
         pack_block = d.stats.get("pack", {})
     finally:
+        _trace.clear()
         for k, v in old.items():
             if v is None:
                 os.environ.pop(k, None)
@@ -306,23 +408,230 @@ def e2e_arm(arm: str, n_cqs: int, rounds: int, n_churn: int,
     gc.collect()
     return {"arm": arm, "decisions": decisions,
             "cycle_wall_ms": round(wall * 1e3 / max(n_cycles, 1), 2),
+            "host_apply_ms": host_apply_ms,
             "n_cycles": n_cycles,
             "bytes_h2d": int(bs.get("burst_launch_bytes_h2d", 0)),
             "pack": pack_block}
 
 
 # ---------------------------------------------------------------------------
-# Phase C: the 10M-workload soak
+# Phase B2: the lifted row ceiling + the host apply/WAL microbenches
+# ---------------------------------------------------------------------------
+
+def ceiling_probe(n_cqs: int, seed: int) -> dict:
+    """The lifted row cap, demonstrated on one state: a universe whose
+    LIVE workload count (2 per CQ after preload) crosses the kernel's
+    2^19 row budget while the aggregate-compressed pack stays under it
+    — the row-backed pack of the SAME state does not.  One soak-style
+    round (one arrival per CQ, fused cycles, retirement) measures the
+    honest per-round wall at this size."""
+    log(f"[ceiling] cqs={n_cqs}: building ...")
+    t0 = time.perf_counter()
+    d, clock = build(n_cqs)
+    preload(d, clock, n_cqs, seed)
+    build_s = time.perf_counter() - t0
+    live_rows = len(d.workloads)
+    st = current_structure(d)
+    t1 = time.perf_counter()
+    plan = pack_burst(st, d.queues, d.cache, d.scheduler, clock)
+    pack_agg_s = time.perf_counter() - t1
+    rows_packed = 0 if plan is None else sum(
+        1 for row in plan.keys for k in row if k is not None)
+    with agg_planes_off():
+        t2 = time.perf_counter()
+        plan_row = pack_burst(st, d.queues, d.cache, d.scheduler, clock)
+        pack_row_s = time.perf_counter() - t2
+    rows_row_backed = 0 if plan_row is None else sum(
+        1 for row in plan_row.keys for k in row if k is not None)
+    del plan, plan_row
+    # one soak-style round at the ceiling: the per-round wall that
+    # sizes any longer soak at this universe
+    clock.t += 1.0
+    t3 = time.perf_counter()
+    for i in range(n_cqs):
+        d.create_workload(mk(f"ceil-{i}", f"lq-{i}", 2500,
+                             prio=(i % 3) * 10, t=clock.t + i * 1e-4))
+    recs = d.schedule_burst(
+        4, runtime=2,
+        on_cycle_start=lambda k: setattr(clock, "t", clock.t + 1.0))
+    admitted = sum(len(s.admitted) for s in recs)
+    done = [k for k, w in d.workloads.items() if w.is_finished]
+    for k in done:
+        d.delete_workload(k)
+    round_s = time.perf_counter() - t3
+    out = {
+        "cqs": n_cqs,
+        "row_budget": ROW_BUDGET,
+        "live_rows": live_rows,
+        "rows_packed": rows_packed,
+        "rows_row_backed": rows_row_backed,
+        "packed_under_budget": rows_packed < ROW_BUDGET,
+        "row_backed_over_budget": rows_row_backed >= ROW_BUDGET,
+        "pack_ms_agg": round(pack_agg_s * 1e3, 1),
+        "pack_ms_row_backed": round(pack_row_s * 1e3, 1),
+        "build_s": round(build_s, 1),
+        "round": {"arrivals": n_cqs, "admitted": admitted,
+                  "retired": len(done), "wall_s": round(round_s, 1)},
+        "rss_mb": rss_mb(),
+    }
+    log(f"[ceiling] cqs={n_cqs}: live={live_rows} "
+        f"packed={rows_packed} row_backed={rows_row_backed} "
+        f"(budget {ROW_BUDGET}), round={out['round']['wall_s']}s, "
+        f"rss={rss_mb()}MB")
+    del d
+    gc.collect()
+    return out
+
+
+class HeapItem:
+    __slots__ = ("key", "prio", "ts")
+
+    def __init__(self, key, prio, ts):
+        self.key = key
+        self.prio = prio
+        self.ts = ts
+
+
+def _heap_less(a, b):
+    if a.prio != b.prio:
+        return a.prio > b.prio
+    if a.ts != b.ts:
+        return a.ts < b.ts
+    return a.key < b.key
+
+
+def heap_bench(n_items: int, batch: int, cycles: int, seed: int) -> dict:
+    """Per-cycle decision-apply cost on the CQ heap, lazy vs eager.
+
+    One burst cycle's apply touches each decided key several times
+    (requeue, backoff bump, priority/park update) and only the NEXT
+    cycle's head read needs order — the access pattern lazy repair
+    amortizes: eager pays a sift per touch, lazy pays a dict write per
+    touch and one sift per KEY at the settle.  The same scripted storm
+    replays on both arms; drain parity at the end re-proves order
+    equality at this size."""
+    points = []
+    order_parity = True
+    for touches in (1, 4, 8):
+        rng = random.Random(seed * 7 + touches)
+        storms = []
+        for _ in range(cycles):
+            ops = []
+            for _ in range(batch):
+                key = f"w{rng.randrange(n_items)}"
+                for _ in range(touches):
+                    ops.append((key, rng.choice((0, 10, 50)),
+                                round(rng.random() * 1e3, 3)))
+            storms.append(ops)
+        walls = {}
+        drains = {}
+        for lazy in (False, True):
+            h = Heap(key_fn=lambda it: it.key, less=_heap_less,
+                     lazy=lazy)
+            for i in range(n_items):
+                h.push_or_update(HeapItem(f"w{i}", i % 50, float(i)))
+            h.peek()   # settle the prefill outside the timed region
+            t0 = time.perf_counter()
+            for ops in storms:
+                for key, prio, ts in ops:
+                    h.push_or_update(HeapItem(key, prio, ts))
+                # the next cycle's head read + requeue roundtrip
+                top = h.pop()
+                if top is not None:
+                    h.push_or_update(top)
+            walls[lazy] = (time.perf_counter() - t0) * 1e3 / cycles
+            seq = []
+            while (it := h.pop()) is not None:
+                seq.append(it.key)
+            drains[lazy] = seq
+        if drains[False] != drains[True]:
+            order_parity = False
+        points.append({
+            "touches_per_key": touches,
+            "eager_ms_per_cycle": round(walls[False], 3),
+            "lazy_ms_per_cycle": round(walls[True], 3),
+            "speedup": round(walls[False] / max(walls[True], 1e-9), 2),
+        })
+        log(f"[heap] items={n_items} touches={touches}: "
+            f"eager={points[-1]['eager_ms_per_cycle']}ms "
+            f"lazy={points[-1]['lazy_ms_per_cycle']}ms "
+            f"({points[-1]['speedup']}x)")
+    return {"items": n_items, "batch": batch, "cycles": cycles,
+            "order_parity": order_parity, "points": points}
+
+
+def wal_shard_bench(prefix: str, n_ops: int, shards: int,
+                    commit_every: int) -> dict:
+    """Append + group-commit wall for one high-rate decision stream,
+    single-file vs sharded, and replay parity: the sharded tail merged
+    back into seq order must equal the unsharded tail op for op (seq
+    stamps aside), live and after a file round-trip."""
+    def drive(w):
+        t0 = time.perf_counter()
+        for i in range(n_ops):
+            w.log({"op": "admit", "key": f"ns/w{i}",
+                   "cq": f"cq-{i % 257}", "at": float(i)})
+            if (i + 1) % 32 == 0:
+                w.commit()
+        for i in range(5):   # the open tail a crash would replay
+            w.log({"op": "evict", "key": f"ns/w{i}", "at": float(i)})
+        return (time.perf_counter() - t0) * 1e3
+
+    p1, pk = prefix + ".one", prefix + ".striped"
+    for p in glob.glob(p1 + "*") + glob.glob(pk + "*"):
+        os.remove(p)
+    w1 = CycleWAL(p1, commit_every=commit_every)
+    ms1 = drive(w1)
+    wk = ShardedCycleWAL(pk, shards=shards, commit_every=commit_every)
+    msk = drive(wk)
+
+    def strip(ops):
+        return [{k: v for k, v in op.items() if k != "seq"}
+                for op in ops]
+
+    tails_equal = strip(wk.tail) == list(w1.tail)
+    committed1 = sum(len(b) for b in w1.batches)
+    committedk = sum(len(b) for sh in wk._shards for b in sh.batches)
+    skew = wk.stats["wal_shard_skew"]
+    w1.close()
+    wk.close()
+    l1, lk = load_cycle_wal(p1), load_cycle_wal(pk)
+    roundtrip = (isinstance(lk, ShardedCycleWAL)
+                 and strip(lk.tail) == list(l1.tail)
+                 and strip(lk.tail) == strip(wk.tail))
+    for p in glob.glob(p1 + "*") + glob.glob(pk + "*"):
+        os.remove(p)
+    out = {
+        "ops": n_ops,
+        "shards": shards,
+        "commit_every": commit_every,
+        "single_ms": round(ms1, 1),
+        "sharded_ms": round(msk, 1),
+        "single_ops_per_s": round(n_ops / max(ms1 / 1e3, 1e-9)),
+        "sharded_ops_per_s": round(n_ops / max(msk / 1e3, 1e-9)),
+        "commit_speedup": round(ms1 / max(msk, 1e-9), 2),
+        "shard_skew": skew,
+        "replay_parity": bool(tails_equal and roundtrip
+                              and committed1 == committedk),
+    }
+    log(f"[wal] {n_ops} ops: single={out['single_ms']}ms "
+        f"sharded({shards})={out['sharded_ms']}ms "
+        f"parity={'OK' if out['replay_parity'] else 'DIVERGED'}")
+    return out
+
+
+# ---------------------------------------------------------------------------
+# Phase C: the high-count workload soak
 # ---------------------------------------------------------------------------
 
 def soak(n_cqs: int, target: int, seed: int, wal_path: str,
-         commit_every: int) -> dict:
+         commit_every: int, wal_shards: int = 1) -> dict:
     log(f"[soak] cqs={n_cqs} target={target} workloads, "
-        f"wal commit_every={commit_every} ...")
+        f"wal commit_every={commit_every} shards={wal_shards} ...")
     t0 = time.perf_counter()
     d, clock = build(n_cqs)
-    wal = CycleWAL(wal_path, commit_every=commit_every,
-                   compact_every=64)
+    wal = make_cycle_wal(wal_path, commit_every=commit_every,
+                         compact_every=64, shards=wal_shards)
     d.attach_wal(wal)
     rng = random.Random(seed + 3)
     created = finished = admitted = 0
@@ -373,8 +682,9 @@ def soak(n_cqs: int, target: int, seed: int, wal_path: str,
         finished += len(done)
     wal_stats = dict(wal.stats)
     wal.close()
-    wal_size = os.path.getsize(wal_path) if os.path.exists(wal_path) \
-        else 0
+    # single-file layout is wal_path itself; sharded is wal_path.sNN
+    wal_size = sum(os.path.getsize(p)
+                   for p in glob.glob(wal_path + "*"))
     pack_block = d.stats.get("pack", {})
     wall = time.perf_counter() - t0
     out = {
@@ -391,6 +701,7 @@ def soak(n_cqs: int, target: int, seed: int, wal_path: str,
         "wal": {**wal_stats,
                 "commit_every": commit_every,
                 "compact_every": 64,
+                "layout": "sharded" if wal_shards > 1 else "single",
                 "final_file_bytes": wal_size},
         "pack_counters": pack_block,
     }
@@ -417,11 +728,19 @@ def main() -> int:
                     help="CQs churned per boundary (the 'activity')")
     ap.add_argument("--soak-workloads", type=int, default=0,
                     help="0 = 10M full / 100k quick")
+    ap.add_argument("--soak-cqs", type=int, default=0,
+                    help="soak universe size (0 = largest curve size)")
+    ap.add_argument("--ceiling-cqs", type=int, default=0,
+                    help="row-ceiling probe size (0 = 3x the largest "
+                         "curve size full / 2x quick)")
+    ap.add_argument("--wal-shards", type=int, default=4,
+                    help="CycleWAL segments for the soak (1 = the "
+                         "classic single file)")
     ap.add_argument("--quick", action="store_true",
                     help="4k-CQ ceiling + 100k-workload soak")
     ap.add_argument("--out", default=os.path.join(
         os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
-        "SCALE_r13.json"))
+        "SCALE_r18.json"))
     args = ap.parse_args()
 
     if args.sizes:
@@ -433,41 +752,66 @@ def main() -> int:
     boundaries = 4 if args.quick else args.boundaries
     soak_target = args.soak_workloads or (100_000 if args.quick
                                           else 10_000_000)
-    soak_cqs = sizes[-1]
+    soak_cqs = args.soak_cqs or sizes[-1]
+    ceiling_cqs = args.ceiling_cqs or (
+        2 * sizes[-1] if args.quick else 3 * sizes[-1])
     commit_every = int(env_value("KUEUE_TPU_WAL_COMMIT_EVERY", "64"))
     t_start = time.perf_counter()
     log(f"scale soak: sizes={sizes} boundaries={boundaries} "
         f"churn={args.churn} soak={soak_target}@{soak_cqs}cqs "
+        f"ceiling={ceiling_cqs}cqs wal_shards={args.wal_shards} "
         f"seed={args.seed}")
 
     curve = []
     for n in sizes:
         point = pack_curve_point(n, boundaries, args.churn, args.seed)
-        # end-to-end A/B, rebuild interleaved right after streaming on
-        # the same box (the environment-drift control)
+        # end-to-end A/B, rebuild and classic interleaved right after
+        # streaming on the same box (the environment-drift control)
         e_s = e2e_arm("stream", n, args.rounds, args.churn, args.seed)
         e_r = e2e_arm("rebuild", n, args.rounds, args.churn, args.seed)
+        e_c = e2e_arm("classic", n, args.rounds, args.churn, args.seed)
         point["decisions_identical"] = \
             e_s["decisions"] == e_r["decisions"]
+        point["decisions_identical_classic"] = \
+            e_s["decisions"] == e_c["decisions"]
         point["cycle_wall_ms"] = e_s["cycle_wall_ms"]
         point["cycle_wall_ms_rebuild"] = e_r["cycle_wall_ms"]
+        point["cycle_wall_ms_classic"] = e_c["cycle_wall_ms"]
+        point["host_apply_ms"] = e_s["host_apply_ms"]
+        point["host_apply_ms_classic"] = e_c["host_apply_ms"]
+        point["host_apply_speedup"] = round(
+            e_c["host_apply_ms"] / max(e_s["host_apply_ms"], 1e-3), 2)
         point["bytes_h2d_e2e"] = e_s["bytes_h2d"]
         point["e2e_cycles"] = e_s["n_cycles"]
         point["pack_counters"] = e_s["pack"]
         point["pack_counters_rebuild"] = e_r["pack"]
         log(f"[e2e] cqs={n}: cycle={e_s['cycle_wall_ms']}ms "
-            f"(rebuild {e_r['cycle_wall_ms']}ms) decisions "
-            f"{'identical' if point['decisions_identical'] else 'DIVERGED'}")
+            f"(rebuild {e_r['cycle_wall_ms']}ms, classic "
+            f"{e_c['cycle_wall_ms']}ms) host apply "
+            f"{e_s['host_apply_ms']}ms vs {e_c['host_apply_ms']}ms "
+            f"classic, decisions "
+            f"{'identical' if point['decisions_identical'] and point['decisions_identical_classic'] else 'DIVERGED'}")
         curve.append(point)
+
+    ceiling = ceiling_probe(ceiling_cqs, args.seed)
+    heap_micro = heap_bench(
+        n_items=5_000 if args.quick else 100_000,
+        batch=256 if args.quick else 4096,
+        cycles=5 if args.quick else 10, seed=args.seed)
+    wal_block = wal_shard_bench(
+        args.out + ".walbench",
+        n_ops=5_000 if args.quick else 200_000,
+        shards=max(2, args.wal_shards), commit_every=commit_every)
 
     wal_path = os.path.join(os.path.dirname(args.out),
                             "scale_soak_wal.jsonl")
     soak_block = soak(soak_cqs, soak_target, args.seed, wal_path,
-                      commit_every)
-    try:
-        os.remove(wal_path)
-    except OSError:
-        pass
+                      commit_every, wal_shards=args.wal_shards)
+    for p in glob.glob(wal_path + "*"):
+        try:
+            os.remove(p)
+        except OSError:
+            pass
 
     top = curve[-1]
     parity = {
@@ -475,6 +819,10 @@ def main() -> int:
                                     for p in curve),
         "decisions_identical_all": all(p["decisions_identical"]
                                        for p in curve),
+        "decisions_identical_classic_all": all(
+            p["decisions_identical_classic"] for p in curve),
+        "max_res_ts_equal_all": all(p["agg_max_res_ts_equal"]
+                                    for p in curve),
     }
     drift = ab_block(
         treatment={"arm": "stream", "cqs": top["cqs"],
@@ -487,19 +835,118 @@ def main() -> int:
                  "cycle_wall_ms": top["cycle_wall_ms_rebuild"],
                  "pack": top["pack_counters_rebuild"]})
 
+    aggregate = {
+        "flag": "KUEUE_TPU_AGG_PLANES",
+        "row_budget": ROW_BUDGET,
+        "points": [{"cqs": p["cqs"], "live_rows": p["live_rows"],
+                    "rows_packed": p["rows"],
+                    "rows_row_backed": p["rows_row_backed"],
+                    "rows_compressed": p["agg_rows_compressed"],
+                    "max_res_ts_equal": p["agg_max_res_ts_equal"]}
+                   for p in curve],
+        "max_res_ts_equal_all": parity["max_res_ts_equal_all"],
+        "compression_at_max": round(
+            top["rows_row_backed"] / max(top["rows"], 1), 2),
+    }
+    heap_block = {
+        "flag": "KUEUE_TPU_LAZY_HEAP",
+        "microbench": heap_micro,
+        "driver_host_apply": {
+            "cqs": top["cqs"],
+            "optimized_ms_per_cycle": top["host_apply_ms"],
+            "classic_ms_per_cycle": top["host_apply_ms_classic"],
+            "speedup": top["host_apply_speedup"],
+        },
+    }
+    heap_t8 = next(p["speedup"] for p in heap_micro["points"]
+                   if p["touches_per_key"] == 8)
+    soak_rate = soak_block["workloads_per_s"]
+    residues = {
+        "baseline": "SCALE_r13",
+        "entries": [
+            {"id": "live_row_cap",
+             "residue": "every live workload held a packed row, so the "
+                        "kernel's 2^19 composite-key row budget capped "
+                        "LIVE WORKLOADS, not CQs",
+             "status": "lifted",
+             "flag": "KUEUE_TPU_AGG_PLANES",
+             "mechanism": "cohort-forest aggregate planes: admitted "
+                          "rows of non-preempting forests fold into "
+                          "per-CQ aggregates at pack time; kernel rows "
+                          "scale with pending heads + preempting "
+                          "forests",
+             "evidence": {"cqs": ceiling["cqs"],
+                          "live_rows": ceiling["live_rows"],
+                          "rows_packed": ceiling["rows_packed"],
+                          "rows_row_backed": ceiling["rows_row_backed"],
+                          "row_budget": ROW_BUDGET}},
+            {"id": "host_apply_serial",
+             "residue": "the host apply requeued and re-sifted per "
+                        "decision; at 100k CQs the apply dominated the "
+                        "burst cycle",
+             "status": "reduced",
+             "flag": "KUEUE_TPU_CYCLE_BULK_APPLY",
+             "mechanism": "one-settle cycle bulk apply (one deduped "
+                          "requeue pass + one deferred cache rebuild "
+                          "per cycle) + lazy heap repair (one "
+                          "amortized sift pass per ordered read)",
+             "evidence": {
+                 "host_apply_speedup_at_max":
+                     top["host_apply_speedup"],
+                 "heap_speedup_touches_8": heap_t8}},
+            {"id": "wal_group_commit",
+             "residue": "one journal stream serialized every decision "
+                        "append behind a single group-commit flush",
+             "status": "reduced",
+             "flag": "KUEUE_TPU_WAL_SHARDS",
+             "mechanism": "sharded CycleWAL: appends stripe across K "
+                          "segments by workload-key hash; a global "
+                          "monotone seq merges replay back into total "
+                          "order",
+             "evidence": {
+                 "commit_speedup": wal_block["commit_speedup"],
+                 "replay_parity": wal_block["replay_parity"],
+                 "sharded_ops_per_s": wal_block["sharded_ops_per_s"],
+                 "soak_workloads_per_s": soak_rate}},
+        ],
+        "walls": [
+            {"id": "pending_heads",
+             "wall": "pending heads stay row-backed (one packed row "
+                     "per CQ with pending work), so the 2^19 row "
+                     f"budget now caps ACTIVE CQs near {ROW_BUDGET}; "
+                     f"probed at {ceiling['cqs']} CQs with "
+                     f"{ceiling['live_rows']} live workloads"},
+            {"id": "single_core_wall",
+             "wall": f"one soak round at {ceiling['cqs']} CQs costs "
+                     f"{ceiling['round']['wall_s']}s wall on this box; "
+                     f"the soak sustained {soak_rate} workloads/s at "
+                     f"{soak_block['cqs']} CQs — 50M workloads "
+                     f"extrapolates to ~"
+                     f"{round(50e6 / max(soak_rate, 1e-9) / 3600, 1)}h "
+                     "and was not run in one sitting"},
+        ],
+    }
+
     tail = {
-        "metric": "streaming_pack_speedup_at_max_cqs",
-        "unit": "rebuild pack ms / streaming pack ms at the largest "
-                "probed universe",
-        "value": top["pack_speedup"],
+        "metric": "host_apply_speedup_at_max_cqs",
+        "unit": "classic host apply+heap ms / optimized host "
+                "apply+heap ms per cycle at the largest probed "
+                "universe (every optimization bit-identical)",
+        "value": top["host_apply_speedup"],
         "cqs": top["cqs"],
+        "pack_speedup_at_max_cqs": top["pack_speedup"],
         "seed": args.seed,
         "quick": bool(args.quick),
         "mesh": mesh_info(),
         "sizes": sizes,
         "curve": curve,
         "parity": parity,
+        "ceiling": ceiling,
+        "aggregate": aggregate,
+        "heap": heap_block,
+        "wal_shard": wal_block,
         "soak": soak_block,
+        "residues": residues,
         "control": drift["control"],
         "environment_drift": drift,
         "wall_s_total": round(time.perf_counter() - t_start, 1),
@@ -509,6 +956,8 @@ def main() -> int:
         "value": tail["value"],
         "planes_identical_all": parity["planes_identical_all"],
         "decisions_identical_all": parity["decisions_identical_all"],
+        "decisions_identical_classic_all":
+            parity["decisions_identical_classic_all"],
         "soak_completed": soak_block["completed"]}))
     with open(args.out, "w") as f:
         json.dump(tail, f, indent=1)
@@ -516,6 +965,10 @@ def main() -> int:
     log(f"wrote {args.out} ({tail['wall_s_total']}s total)")
     ok = (parity["planes_identical_all"]
           and parity["decisions_identical_all"]
+          and parity["decisions_identical_classic_all"]
+          and parity["max_res_ts_equal_all"]
+          and heap_micro["order_parity"]
+          and wal_block["replay_parity"]
           and soak_block["completed"])
     return 0 if ok else 1
 

@@ -314,6 +314,168 @@ def _check_scale(d, path, out):
             or control.get("interleaved") is not True:
         _err(out, path, "'control' must be an object with "
              "interleaved=true (same-box environment-drift arm)")
+    rnd = re.match(r"SCALE_R(\d+)", os.path.basename(path).upper())
+    if rnd and int(rnd.group(1)) >= 18:
+        _check_scale_r18(d, path, out, curve)
+
+
+def _check_scale_r18(d, path, out, curve):
+    """SCALE_r18+ (scripts/scale_soak.py, ISSUE 16): the classic
+    (all-scale-optimizations-off) bit-identity arm per size, the lifted
+    row ceiling, the aggregate/heap/wal_shard measurement blocks, and
+    the machine-readable residue ledger with named walls."""
+    for e in curve:
+        if not isinstance(e, dict) or not isinstance(e.get("cqs"), int):
+            continue
+        n = e["cqs"]
+        if not isinstance(e.get("decisions_identical_classic"), bool):
+            _err(out, path, f"'curve' size {n}: missing bool "
+                 "'decisions_identical_classic' (r18 classic arm)")
+        for k in ("host_apply_ms", "host_apply_ms_classic"):
+            if not isinstance(e.get(k), (int, float)):
+                _err(out, path, f"'curve' size {n}: missing numeric "
+                     f"'{k}'")
+        for k in ("live_rows", "rows_row_backed"):
+            if not isinstance(e.get(k), int):
+                _err(out, path, f"'curve' size {n}: missing int '{k}'")
+    parity = d.get("parity") if isinstance(d.get("parity"), dict) else {}
+    for k in ("decisions_identical_classic_all", "max_res_ts_equal_all"):
+        if not isinstance(parity.get(k), bool):
+            _err(out, path, f"'parity.{k}' must be a bool (r18)")
+    if isinstance(parity.get("decisions_identical_classic_all"), bool) \
+            and curve and all(isinstance(e, dict) for e in curve):
+        got = all(e.get("decisions_identical_classic") is True
+                  for e in curve)
+        if parity["decisions_identical_classic_all"] != got:
+            _err(out, path, "'parity.decisions_identical_classic_all' "
+                 "inconsistent with the per-size verdicts")
+    ceiling = d.get("ceiling")
+    if not isinstance(ceiling, dict):
+        _err(out, path, "r18 artifacts must carry a 'ceiling' block")
+        ceiling = {}
+    for k in ("cqs", "row_budget", "live_rows", "rows_packed",
+              "rows_row_backed"):
+        if not isinstance(ceiling.get(k), int):
+            _err(out, path, f"'ceiling.{k}' must be an int")
+    for k in ("packed_under_budget", "row_backed_over_budget"):
+        if not isinstance(ceiling.get(k), bool):
+            _err(out, path, f"'ceiling.{k}' must be a bool")
+    if isinstance(ceiling.get("rows_packed"), int) \
+            and isinstance(ceiling.get("row_budget"), int) \
+            and isinstance(ceiling.get("packed_under_budget"), bool) \
+            and ceiling["packed_under_budget"] != (
+                ceiling["rows_packed"] < ceiling["row_budget"]):
+        _err(out, path, "'ceiling.packed_under_budget' inconsistent "
+             "with rows_packed vs row_budget")
+    rnd = ceiling.get("round")
+    if not isinstance(rnd, dict) \
+            or not isinstance(rnd.get("wall_s"), (int, float)):
+        _err(out, path, "'ceiling.round' must carry numeric 'wall_s' "
+             "(the honest per-round wall at the ceiling size)")
+    agg = d.get("aggregate")
+    if not isinstance(agg, dict):
+        _err(out, path, "r18 artifacts must carry an 'aggregate' block")
+        agg = {}
+    if agg.get("max_res_ts_equal_all") is not True:
+        _err(out, path, "'aggregate.max_res_ts_equal_all' must be "
+             "true: compression must not move the clock anchor")
+    pts = agg.get("points")
+    if not isinstance(pts, list) or not pts:
+        _err(out, path, "'aggregate.points' must be a non-empty list")
+    else:
+        for p in pts:
+            if not isinstance(p, dict):
+                _err(out, path, "'aggregate.points' entries must be "
+                     "objects")
+                continue
+            for k in ("cqs", "live_rows", "rows_packed",
+                      "rows_row_backed"):
+                if not isinstance(p.get(k), int):
+                    _err(out, path, f"'aggregate.points[].{k}' must "
+                         "be an int")
+            if isinstance(p.get("rows_packed"), int) \
+                    and isinstance(p.get("rows_row_backed"), int) \
+                    and p["rows_packed"] > p["rows_row_backed"]:
+                _err(out, path, "'aggregate.points[]': rows_packed "
+                     "must not exceed rows_row_backed")
+    heap = d.get("heap")
+    if not isinstance(heap, dict):
+        _err(out, path, "r18 artifacts must carry a 'heap' block")
+        heap = {}
+    micro = heap.get("microbench")
+    if not isinstance(micro, dict):
+        _err(out, path, "'heap.microbench' must be an object")
+        micro = {}
+    if micro.get("order_parity") is not True:
+        _err(out, path, "'heap.microbench.order_parity' must be true: "
+             "lazy repair must pop the identical sequence")
+    mpts = micro.get("points")
+    if not isinstance(mpts, list) or not mpts:
+        _err(out, path, "'heap.microbench.points' must be a non-empty "
+             "list")
+    else:
+        for p in mpts:
+            for k in ("eager_ms_per_cycle", "lazy_ms_per_cycle",
+                      "speedup"):
+                if not isinstance(p, dict) \
+                        or not isinstance(p.get(k), (int, float)):
+                    _err(out, path, "'heap.microbench.points[]' must "
+                         f"carry numeric '{k}'")
+                    break
+    dha = heap.get("driver_host_apply")
+    if not isinstance(dha, dict):
+        _err(out, path, "'heap.driver_host_apply' must be an object")
+    else:
+        for k in ("optimized_ms_per_cycle", "classic_ms_per_cycle",
+                  "speedup"):
+            if not isinstance(dha.get(k), (int, float)):
+                _err(out, path, "'heap.driver_host_apply' must carry "
+                     f"numeric '{k}'")
+    ws = d.get("wal_shard")
+    if not isinstance(ws, dict):
+        _err(out, path, "r18 artifacts must carry a 'wal_shard' block")
+        ws = {}
+    if not isinstance(ws.get("shards"), int) or ws.get("shards", 0) < 2:
+        _err(out, path, "'wal_shard.shards' must be an int >= 2")
+    if ws.get("replay_parity") is not True:
+        _err(out, path, "'wal_shard.replay_parity' must be true: the "
+             "seq-merged sharded replay must equal the single-file "
+             "replay")
+    for k in ("single_ms", "sharded_ms"):
+        if not isinstance(ws.get(k), (int, float)):
+            _err(out, path, f"'wal_shard.{k}' must be numeric")
+    res = d.get("residues")
+    if not isinstance(res, dict):
+        _err(out, path, "r18 artifacts must carry a 'residues' block "
+             "(the machine-readable r13-residue ledger)")
+        res = {}
+    entries = res.get("entries")
+    if not isinstance(entries, list) or len(entries) < 3:
+        _err(out, path, "'residues.entries' needs >= 3 entries (row "
+             "cap, host apply, WAL group commit)")
+    else:
+        for e in entries:
+            if not isinstance(e, dict):
+                _err(out, path, "'residues.entries' must be objects")
+                continue
+            for k in ("id", "residue", "status", "mechanism"):
+                if not isinstance(e.get(k), str) or not e[k]:
+                    _err(out, path, "'residues.entries[]' must carry "
+                         f"non-empty str '{k}'")
+            if not isinstance(e.get("evidence"), dict):
+                _err(out, path, "'residues.entries[]' must carry an "
+                     "'evidence' object of measured values")
+    walls = res.get("walls")
+    if not isinstance(walls, list) or not walls:
+        _err(out, path, "'residues.walls' must be a non-empty list of "
+             "named remaining walls")
+    else:
+        for w in walls:
+            if not isinstance(w, dict) \
+                    or not isinstance(w.get("id"), str) \
+                    or not isinstance(w.get("wall"), str):
+                _err(out, path, "'residues.walls[]' must carry str "
+                     "'id' and 'wall'")
 
 
 def _check_traffic(d, path, out):

@@ -1,0 +1,179 @@
+"""Lazy heap repair: provably order-identical to eager repair.
+
+``Heap(lazy=True)`` buffers push/update into a pending overlay and
+settles with one amortized pass at the next ordered read; because the
+comparator is a strict total order (key tiebreak), peek/pop must
+return exactly what the eager heap returns for the same mutation
+history.  The property test drives twin heaps through randomized op
+storms across 10 seeds and compares every observable — pops, peeks,
+membership, lengths — op for op.  The queue-level test does the same
+through ``ClusterQueueQueue`` with the env flag flipped, which is the
+wiring the driver actually uses.
+"""
+
+from __future__ import annotations
+
+import random
+from dataclasses import dataclass, field
+
+import pytest
+
+from kueue_tpu.utils import heap as heap_mod
+from kueue_tpu.utils.heap import Heap
+
+
+@dataclass
+class Item:
+    key: str
+    prio: int
+    ts: float
+
+
+def less(a: Item, b: Item) -> bool:
+    """queue_ordering_less shape: priority desc, ts asc, key tiebreak."""
+    if a.prio != b.prio:
+        return a.prio > b.prio
+    if a.ts != b.ts:
+        return a.ts < b.ts
+    return a.key < b.key
+
+
+def make_pair():
+    eager = Heap(key_fn=lambda i: i.key, less=less, lazy=False)
+    lazy = Heap(key_fn=lambda i: i.key, less=less, lazy=True)
+    return eager, lazy
+
+
+def rand_item(rng, universe):
+    return Item(key=f"k{rng.randrange(universe)}",
+                prio=rng.choice([0, 0, 10, 50]),
+                ts=round(rng.random() * 100, 3))
+
+
+@pytest.mark.parametrize("seed", range(10))
+def test_lazy_heap_matches_eager_property(seed):
+    """10-seed randomized storm: every observable of the lazy heap is
+    identical to the eager heap after the same op sequence."""
+    rng = random.Random(4200 + seed)
+    eager, lazy = make_pair()
+    for step in range(600):
+        roll = rng.random()
+        if roll < 0.45:
+            it = rand_item(rng, universe=60)
+            eager.push_or_update(it)
+            lazy.push_or_update(Item(it.key, it.prio, it.ts))
+        elif roll < 0.55:
+            it = rand_item(rng, universe=60)
+            a = eager.push_if_not_present(it)
+            b = lazy.push_if_not_present(Item(it.key, it.prio, it.ts))
+            assert a == b
+        elif roll < 0.70:
+            key = f"k{rng.randrange(60)}"
+            assert eager.delete(key) == lazy.delete(key)
+        elif roll < 0.85:
+            a, b = eager.pop(), lazy.pop()
+            assert (a is None) == (b is None)
+            if a is not None:
+                assert (a.key, a.prio, a.ts) == (b.key, b.prio, b.ts)
+        else:
+            a, b = eager.peek(), lazy.peek()
+            assert (a is None) == (b is None)
+            if a is not None:
+                assert a.key == b.key
+        # unordered observables stay consistent without settling
+        assert len(eager) == len(lazy)
+        assert sorted(eager.keys()) == sorted(lazy.keys())
+        probe = f"k{rng.randrange(60)}"
+        ea, la = eager.get(probe), lazy.get(probe)
+        assert (ea is None) == (la is None)
+        if ea is not None:
+            assert (ea.prio, ea.ts) == (la.prio, la.ts)
+    # drain both completely: the full pop order is the total order
+    drained = []
+    while True:
+        a, b = eager.pop(), lazy.pop()
+        assert (a is None) == (b is None)
+        if a is None:
+            break
+        assert a.key == b.key
+        drained.append(a)
+    for x, y in zip(drained, drained[1:]):
+        assert less(x, y), "pop order must follow the comparator"
+
+
+def test_lazy_heap_items_reflect_pending_overlay():
+    _, lazy = make_pair()
+    lazy.push_or_update(Item("a", 10, 1.0))
+    lazy.pop()                                  # settles: a indexed? no - popped
+    lazy.push_or_update(Item("a", 10, 1.0))
+    lazy.peek()                                 # settle: a in the array
+    lazy.push_or_update(Item("a", 50, 2.0))     # buffered update
+    lazy.push_or_update(Item("b", 0, 3.0))      # buffered fresh
+    assert len(lazy) == 2
+    got = {i.key: i for i in lazy.items()}
+    assert got["a"].prio == 50, "items() must prefer the overlay"
+    assert got["b"].prio == 0
+    assert lazy.get("a").prio == 50
+    # delete straight out of the overlay, no settle
+    assert lazy.delete("b") is True
+    assert len(lazy) == 1 and lazy.pop().key == "a"
+
+
+def test_lazy_heap_settle_counters_and_bulk_path():
+    before = dict(heap_mod.REPAIR_STATS)
+    _, lazy = make_pair()
+    for i in range(32):
+        lazy.push_or_update(Item(f"k{i}", i % 5, float(i)))
+    ds = heap_mod.REPAIR_STATS
+    assert ds["heap_repair_deferred"] - before["heap_repair_deferred"] == 32
+    assert ds["heap_repair_settles"] == before["heap_repair_settles"]
+    assert lazy.peek() is not None              # ONE settle for the storm
+    assert ds["heap_repair_settles"] - before["heap_repair_settles"] == 1
+    assert ds["heap_repair_settled_items"] \
+        - before["heap_repair_settled_items"] == 32
+    assert ds["heap_repair_bulk"] - before["heap_repair_bulk"] == 1
+    lazy.push_or_update(Item("k0", 99, 0.0))    # small overlay: sift path
+    assert lazy.peek().key == "k0"
+    assert ds["heap_repair_bulk"] - before["heap_repair_bulk"] == 1
+    assert ds["heap_repair_settles"] - before["heap_repair_settles"] == 2
+
+
+def test_cluster_queue_storm_parity_lazy_vs_eager(monkeypatch):
+    """The driver-level wiring: a ClusterQueueQueue built with the flag
+    on must pop the identical head sequence as one built with it off,
+    through a push/park/delete storm."""
+    from kueue_tpu.api.types import PodSet, QueueingStrategy, Workload
+    from kueue_tpu.queue.cluster_queue import ClusterQueueQueue
+    from kueue_tpu.workload import Info, Ordering
+
+    def mk_info(name, prio, t):
+        return Info(Workload(name=name, queue_name="lq", priority=prio,
+                             creation_time=t,
+                             pod_sets=[PodSet(name="main", count=1,
+                                              requests={"cpu": 100})]))
+
+    def run(flag):
+        monkeypatch.setenv("KUEUE_TPU_LAZY_HEAP", flag)
+        q = ClusterQueueQueue("cq", QueueingStrategy.BEST_EFFORT_FIFO,
+                              Ordering(), clock=lambda: 1000.0)
+        assert q.heap._lazy == (flag != "0")
+        rng = random.Random(99)
+        popped = []
+        for step in range(400):
+            roll = rng.random()
+            if roll < 0.5:
+                q.push_or_update(mk_info(f"w{rng.randrange(40)}",
+                                         rng.choice([0, 10, 50]),
+                                         round(rng.random() * 50, 3)))
+            elif roll < 0.65:
+                q.delete(f"default/w{rng.randrange(40)}")
+            elif roll < 0.9:
+                info = q.pop()
+                popped.append(None if info is None else info.key)
+            else:
+                popped.append(("len", len(q.heap)))
+        while (info := q.pop()) is not None:
+            popped.append(info.key)
+        return popped
+
+    assert run("1") == run("0")

@@ -68,9 +68,11 @@ def test_northstar_hetero_quick(tmp_path):
 
 def test_scale_soak_quick(tmp_path):
     """The scale ceiling end to end at smoke scale: streaming vs
-    rebuild pack arms on the same state up to 4k CQs, bit-identical
-    planes + decisions at every probed size, and a completed mini-soak
-    with the group-committed WAL attached."""
+    rebuild vs classic (all r18 optimizations off) arms on the same
+    state up to 4k CQs, bit-identical planes + decisions at every
+    probed size, the row-ceiling probe, the heap/WAL-shard benches,
+    the residue ledger, and a completed mini-soak with the sharded
+    group-committed WAL attached."""
     out = str(tmp_path / "SCALE_r99.json")
     d = _run_quick("scale_soak.py", out,
                    extra=("--soak-workloads", "20000"))
@@ -78,13 +80,29 @@ def test_scale_soak_quick(tmp_path):
     assert d["sizes"] == [1000, 4000]
     assert d["parity"]["planes_identical_all"] is True
     assert d["parity"]["decisions_identical_all"] is True
+    # every r18 optimization off must still be bit-identical
+    assert d["parity"]["decisions_identical_classic_all"] is True
+    assert d["parity"]["max_res_ts_equal_all"] is True
     assert d["soak"]["completed"] is True
     assert d["soak"]["wal"]["wal_commits"] > 0
     # group commit: strictly fewer fsyncs than commits
     assert d["soak"]["wal"]["wal_fsyncs"] < d["soak"]["wal"]["wal_commits"]
+    # the soak WAL runs sharded by default from r18 on
+    assert d["soak"]["wal"]["layout"] == "sharded"
+    assert d["soak"]["wal"]["wal_shards"] >= 2
     assert d["control"]["interleaved"] is True
     # streaming must already beat the rebuild arm at 4k CQs
     assert d["curve"][-1]["pack_speedup"] > 1.0
+    # aggregate compression shrinks the packed planes (admitted rows
+    # of the non-preempting soak cluster fold into aggregates)
+    assert d["aggregate"]["max_res_ts_equal_all"] is True
+    top = d["aggregate"]["points"][-1]
+    assert top["rows_packed"] < top["rows_row_backed"]
+    assert d["ceiling"]["rows_packed"] <= d["ceiling"]["rows_row_backed"]
+    assert d["heap"]["microbench"]["order_parity"] is True
+    assert d["wal_shard"]["replay_parity"] is True
+    assert len(d["residues"]["entries"]) >= 3
+    assert d["residues"]["walls"]
     assert _validate(out) == []
 
 

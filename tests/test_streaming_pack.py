@@ -95,11 +95,17 @@ def _reserved_unadmitted(d):
                   and w.has_quota_reservation and not w.is_admitted)
 
 
-def test_streaming_parity_row_grade_check_flips():
+def test_streaming_parity_row_grade_check_flips(monkeypatch):
     """Admission-check state flips journal row-grade dirt (touch_row):
     one ready check out of two moves exactly one workload's ok bit —
     the streaming pack must patch that single row, not re-walk the CQ,
-    and stay bit-identical to a fresh pack at every boundary."""
+    and stay bit-identical to a fresh pack at every boundary.
+
+    Pinned to the uncompressed arm: with aggregate planes on, these
+    reserved rows are compressed out of the pack and the row patch is
+    (correctly) skipped — tests/test_aggregate_compression.py covers
+    that side."""
+    monkeypatch.setenv("KUEUE_TPU_AGG_PLANES", "0")
     d, clock = build_checked_cluster()
     for i in range(8):
         d.create_workload(mk(f"w{i}", f"lq-{i % 4}", 2000,

@@ -1,0 +1,316 @@
+"""Sharded CycleWAL: striped group-commit with merged total-order replay.
+
+The single-file CycleWAL serializes every cycle's ops through one
+write+flush stream; ``ShardedCycleWAL`` stripes them across K segment
+files by a stable hash of the workload key while a global ``seq``
+stamp preserves total order.  These tests prove the sharded layout is
+a drop-in: unit round-trips (merged tail order, load autodetection,
+skew stats), crash/replay parity against an unsharded control arm at
+every ``wal.*`` chaos site the driver threads (admit, evict, requeue,
+finish), and the new ``wal.shard_merge`` site — a crash between
+per-segment compactions that leaves segments at mixed generations
+which the seq-merged recovery read must absorb.
+"""
+
+from __future__ import annotations
+
+import os
+
+import pytest
+
+from kueue_tpu.chaos import injector as chaos
+from kueue_tpu.chaos.injector import ChaosInjector, InjectedCrash
+from kueue_tpu.controller.driver import Driver, WaitForPodsReadyConfig
+from kueue_tpu.utils.journal import (
+    CycleWAL,
+    ShardedCycleWAL,
+    load_cycle_wal,
+    make_cycle_wal,
+)
+
+from tests.conftest import FakeClock
+from test_burst import build, mk, run_host, simple_cluster
+from test_chaos_recovery import (
+    drain_spec,
+    full_state,
+    recover,
+    resume_host,
+    run_host_until_crash,
+)
+
+
+@pytest.fixture(autouse=True)
+def _chaos_off():
+    chaos.clear()
+    yield
+    chaos.clear()
+
+
+# ---------------------------------------------------------------------------
+# Unit round-trips
+# ---------------------------------------------------------------------------
+
+def test_sharded_wal_merges_tail_in_seq_order(tmp_path):
+    path = str(tmp_path / "wal.jsonl")
+    wal = ShardedCycleWAL(path, shards=4)
+    keys = [f"ns/w{i}" for i in range(12)]
+    for i, key in enumerate(keys):
+        wal.log({"op": "requeue", "key": key, "count": i, "at": float(i)})
+    # ops landed across >1 segment, but the merged tail is total-ordered
+    assert [op["key"] for op in wal.tail] == keys
+    assert [op["seq"] for op in wal.tail] == list(range(12))
+    per_seg = [len(sh.tail) for sh in wal._shards]
+    assert sum(per_seg) == 12 and sum(1 for n in per_seg if n) > 1
+    wal.commit()
+    assert wal.tail == []
+    wal.log({"op": "deactivate", "key": "ns/late"})   # uncommitted
+    st = wal.stats
+    assert st["wal_shards"] == 4 and st["wal_appends"] >= 13
+    assert st["wal_shard_skew"] >= 0
+    wal.close()
+
+    assert os.path.exists(ShardedCycleWAL.shard_path(path, 0))
+    loaded = load_cycle_wal(path)
+    assert isinstance(loaded, ShardedCycleWAL)
+    assert loaded.shards == 4
+    assert [op["key"] for op in loaded.tail] == ["ns/late"]
+    assert loaded._seq == 13   # resumes past every stamped seq
+
+
+def test_sharded_routing_is_stable_per_key():
+    wal = ShardedCycleWAL(shards=4)
+    for _ in range(3):
+        wal.log({"op": "requeue", "key": "ns/a", "count": 0, "at": 0.0})
+    homes = [i for i, sh in enumerate(wal._shards) if sh.tail]
+    assert len(homes) == 1, "one workload's ops must share a segment"
+    # batched finish ops route by their first key
+    wal.log({"op": "finish", "keys": ["ns/a", "ns/b"], "message": "m",
+             "at": 1.0})
+    assert len(wal._shards[homes[0]].tail) == 4
+
+
+def test_make_cycle_wal_honors_shard_env(monkeypatch, tmp_path):
+    monkeypatch.setenv("KUEUE_TPU_WAL_SHARDS", "1")
+    assert isinstance(make_cycle_wal(), CycleWAL)
+    monkeypatch.setenv("KUEUE_TPU_WAL_SHARDS", "4")
+    wal = make_cycle_wal(str(tmp_path / "w.jsonl"))
+    assert isinstance(wal, ShardedCycleWAL) and wal.shards == 4
+    wal.close()
+    # explicit arg wins over the flag
+    assert isinstance(make_cycle_wal(shards=1), CycleWAL)
+
+
+# ---------------------------------------------------------------------------
+# Crash/replay parity at the driver's wal.* sites, sharded layout
+# ---------------------------------------------------------------------------
+
+def test_sharded_crash_mid_admit_replays_merged_tail(tmp_path):
+    """wal.admit under the sharded layout: the admit op is journaled in
+    one segment, the store write never lands; the merged-tail replay
+    must converge on the unsharded control arm's exact state."""
+    spec, cluster = drain_spec(), simple_cluster()
+    dc, cc = build(spec)
+    control = run_host(dc, cc, 12, 2)
+
+    d1, c1 = build(spec)
+    wal = ShardedCycleWAL(str(tmp_path / "wal.jsonl"), shards=4)
+    d1.attach_wal(wal)
+    chaos.install(ChaosInjector(seed=3)).arm("wal.admit", at=5)
+    out, crashed = run_host_until_crash(d1, c1, 12, 2)
+    assert crashed
+    tail_admits = {op["key"] for op in wal.tail if op["op"] == "admit"}
+    assert tail_admits, "crash site must leave journaled-but-unapplied ops"
+    chaos.clear()
+
+    d2 = recover(cluster, d1, wal)
+    assert wal.tail == [], "recovery must commit the replayed tail"
+    k = len(out)
+    resume_host(d2, c1, k + 1, 2, out, tick_first=False)
+    assert tail_admits <= set(control[k].admitted)
+    assert set(out[k].admitted) == set(control[k].admitted) - tail_admits
+    out[k].admitted.extend(sorted(tail_admits))
+    resume_host(d2, c1, 12, 2, out)
+    for i, (x, y) in enumerate(zip(out, control)):
+        assert sorted(x.admitted) == sorted(y.admitted), f"cycle {i}"
+    assert d2.admitted_keys() == dc.admitted_keys()
+    assert full_state(d2) == full_state(dc)
+    # the on-disk segment files round-trip through the autodetecting
+    # recovery read path
+    wal.close()
+    loaded = load_cycle_wal(str(tmp_path / "wal.jsonl"))
+    assert isinstance(loaded, ShardedCycleWAL) and loaded.tail == []
+
+
+@pytest.mark.parametrize("site", ["wal.requeue", "wal.evict"])
+def test_sharded_crash_mid_evict_sites_replay(site):
+    """wal.requeue / wal.evict under the sharded layout: the ops land
+    in seq order across segments; replay applies the requeue backoff
+    and the eviction exactly once, matching an uncrashed control."""
+    def mk_driver(clock):
+        d = Driver(clock=clock, wait_for_pods_ready=WaitForPodsReadyConfig(
+            enable=True, timeout_seconds=30.0,
+            requeuing_backoff_base_seconds=10,
+            requeuing_backoff_max_seconds=100))
+        simple_cluster(n_cohorts=1, cqs=1)(d)
+        d.create_workload(mk("slow", "lq-0-0", 1000, t=1.0))
+        return d
+
+    clock_c, clock_x = FakeClock(), FakeClock()
+    dc = mk_driver(clock_c)
+    dc.run_until_settled()
+    clock_c.tick(31.0)
+    dc.evict_for_pods_ready_timeout("default/slow")
+
+    d1 = mk_driver(clock_x)
+    wal = ShardedCycleWAL(shards=3)
+    d1.attach_wal(wal)
+    d1.run_until_settled()
+    clock_x.tick(31.0)
+    chaos.install(ChaosInjector(seed=1)).arm(site, at=1)
+    with pytest.raises(InjectedCrash):
+        d1.evict_for_pods_ready_timeout("default/slow")
+    chaos.clear()
+    journaled = list(wal.tail)
+    kinds = [op["op"] for op in journaled]
+    if site == "wal.requeue":
+        assert kinds == ["requeue"]
+    else:
+        assert kinds == ["requeue", "evict"], \
+            "merged tail must keep the journal's total order"
+
+    d2 = Driver(clock=clock_x, wait_for_pods_ready=WaitForPodsReadyConfig(
+        enable=True, timeout_seconds=30.0,
+        requeuing_backoff_base_seconds=10,
+        requeuing_backoff_max_seconds=100))
+    simple_cluster(n_cohorts=1, cqs=1)(d2)
+    replayed = d2.recover_from(d1.workloads.values(), wal)
+    assert replayed >= 1
+    if site == "wal.evict":
+        # requeue + evict both journaled: replay lands the whole cycle
+        assert full_state(d2) == full_state(dc)
+        assert d2.workloads["default/slow"].requeue_state.count == 1
+    else:
+        # only the requeue op reached the journal: recovery lands the
+        # backoff exactly once, with the journaled deadline, and leaves
+        # the never-journaled eviction to the enforcement loop
+        w = d2.workloads["default/slow"]
+        assert w.requeue_state.count == 1
+        assert w.requeue_state.requeue_at == journaled[0]["at"]
+        assert w.has_quota_reservation
+
+
+def test_sharded_crash_mid_finish_replays(tmp_path):
+    """wal.finish under the sharded layout: the batched finish op is
+    journaled, the condition flips are not; replay finishes exactly
+    once and the freed quota is reusable."""
+    def mk_driver(clock):
+        d = Driver(clock=clock)
+        simple_cluster(n_cohorts=1, cqs=1)(d)
+        d.create_workload(mk("job", "lq-0-0", 1000, t=1.0))
+        return d
+
+    clock_c, clock_x = FakeClock(), FakeClock()
+    dc = mk_driver(clock_c)
+    dc.run_until_settled()
+    clock_c.tick(5.0)
+    dc.finish_workloads(["default/job"], message="done")
+
+    d1 = mk_driver(clock_x)
+    wal = ShardedCycleWAL(str(tmp_path / "wal.jsonl"), shards=2)
+    d1.attach_wal(wal)
+    d1.run_until_settled()
+    clock_x.tick(5.0)
+    chaos.install(ChaosInjector(seed=2)).arm("wal.finish", at=1)
+    with pytest.raises(InjectedCrash):
+        d1.finish_workloads(["default/job"], message="done")
+    chaos.clear()
+    assert [op["op"] for op in wal.tail] == ["finish"]
+    assert not d1.workloads["default/job"].is_finished
+
+    d2 = Driver(clock=clock_x)
+    simple_cluster(n_cohorts=1, cqs=1)(d2)
+    replayed = d2.recover_from(d1.workloads.values(), wal)
+    assert replayed >= 1
+    assert d2.workloads["default/job"].is_finished
+    assert full_state(d2) == full_state(dc)
+    for d in (dc, d2):
+        d.create_workload(mk("next", "lq-0-0", 1000, t=10.0))
+        d.run_until_settled()
+        assert "default/next" in d.admitted_keys()
+    assert full_state(d2) == full_state(dc)
+
+
+# ---------------------------------------------------------------------------
+# Mixed-generation compaction: wal.compact + wal.shard_merge
+# ---------------------------------------------------------------------------
+
+def _fill(wal, n=12, commit_each=3):
+    for i in range(n):
+        wal.log({"op": "requeue", "key": f"ns/w{i}", "count": i,
+                 "at": float(i)})
+        if (i + 1) % commit_each == 0:
+            wal.commit()
+    wal.log({"op": "deactivate", "key": "ns/open"})   # live tail
+
+
+def test_sharded_crash_between_segment_compactions(tmp_path):
+    """A crash at ``wal.shard_merge`` lands between segment 0's
+    compaction and the rest: segment 0 is checkpointed, the others
+    still carry their full batch history.  The recovery read must see
+    the same uncommitted tail and the same committed-op multiset as an
+    uncrashed control copy — mixed generations are invisible to replay."""
+    path = str(tmp_path / "wal.jsonl")
+    ctrl = str(tmp_path / "ctrl.jsonl")
+    wal = ShardedCycleWAL(path, shards=3)
+    ref = ShardedCycleWAL(ctrl, shards=3)
+    _fill(wal)
+    _fill(ref)
+
+    chaos.install(ChaosInjector(seed=7)).arm("wal.shard_merge", at=1)
+    with pytest.raises(InjectedCrash):
+        wal.compact()
+    chaos.clear()
+    wal.close()
+    ref.close()
+
+    crashed = load_cycle_wal(path)
+    control = load_cycle_wal(ctrl)
+    # generations diverged: segment 0 carries a checkpoint record, the
+    # rest still hold their full batch history
+    assert crashed._shards[0].folded_ops > 0
+    assert all(sh.folded_ops == 0 for sh in crashed._shards[1:])
+    assert all(sh.folded_ops == 0 for sh in control._shards)
+    # ...but the logical journal is identical to the uncrashed copy
+    assert [op["key"] for op in crashed.tail] \
+        == [op["key"] for op in control.tail] == ["ns/open"]
+
+    def committed_ops(w):
+        """Committed footprint: compaction folds batch contents away,
+        only the op count survives in the checkpoint."""
+        return sum(sh.folded_ops + sum(len(b) for b in sh.batches)
+                   for sh in w._shards)
+    assert committed_ops(crashed) == committed_ops(control)
+    # and tail replay converges on the same store either way
+    sa = {f"ns/w{i}": mk(f"w{i}", "lq", 100) for i in range(12)}
+    sb = {k: mk(k.split("/")[1], "lq", 100) for k in sa}
+    assert crashed.replay_tail(sa) == control.replay_tail(sb)
+
+
+def test_sharded_crash_inside_segment_compaction(tmp_path):
+    """The pre-existing ``wal.compact`` site still fires inside each
+    segment's own compaction: a crash there leaves that segment's old
+    journal intact (the atomic rename never ran) and recovery reads the
+    uncompacted history."""
+    path = str(tmp_path / "wal.jsonl")
+    wal = ShardedCycleWAL(path, shards=2)
+    _fill(wal, n=8, commit_each=2)
+    before = [op["key"] for op in wal.tail]
+    chaos.install(ChaosInjector(seed=4)).arm("wal.compact", at=1)
+    with pytest.raises(InjectedCrash):
+        wal.compact()
+    chaos.clear()
+    wal.close()
+    loaded = load_cycle_wal(path)
+    assert [op["key"] for op in loaded.tail] == before
+    # no checkpoint record landed: the atomic rename never ran
+    assert all(sh.folded_ops == 0 for sh in loaded._shards)
