@@ -91,6 +91,10 @@ class Cache:
         # structure_generation, which forces a full repack by key
         from ..utils.journal import PackJournal
         self.pack_journal = PackJournal()
+        # Parallel host plane (utils/parallel_host.py): the driver hands
+        # its HostPool down so _rebuild can fan the per-root quota
+        # recomputation out across workers; None/inactive = serial.
+        self.host_pool = None
         # Incremental snapshot maintenance: per-cycle snapshot cost is
         # O(arrivals + dirty rows), not O(universe).  The clone forest
         # is retained across cycles and only journal-dirty or
@@ -511,15 +515,32 @@ class Cache:
         # cycle member is never parentless); break their mirrored parent
         # pointers so quota queries stay total, and deactivate their CQs.
         reachable: set[str] = set()
-        for node in self._mgr.roots():
+        roots = list(self._mgr.roots())
+        for node in roots:
             for sub in node.walk_subtree():
                 reachable.add(sub.name)
-            update_cohort_resource_node(node.payload)
+        # Per-root quota recomputation touches only that root's subtree
+        # payloads — the cohort forest is the no-shared-state partition —
+        # so the host pool can fan the roots out across workers; results
+        # are order-free (disjoint writes), the serial loop is the
+        # control arm.
+        pool = self.host_pool
+        if pool is not None and pool.active and len(roots) >= 2:
+            pool.run([(lambda p=node.payload:
+                       update_cohort_resource_node(p)) for node in roots])
+        else:
+            for node in roots:
+                update_cohort_resource_node(node.payload)
         self._cyclic_cohorts = set(self._mgr.cohorts) - reachable
         for name in self._cyclic_cohorts:
             self._mgr.cohorts[name].payload.parent = None
-        for name, cq in self._mgr.cluster_queues.items():
-            if self._mgr.cq_parent(name) is None:
+        loose = [cq for name, cq in self._mgr.cluster_queues.items()
+                 if self._mgr.cq_parent(name) is None]
+        if pool is not None and pool.active and len(loose) >= 2:
+            pool.run([(lambda c=cq:
+                       update_cluster_queue_resource_node(c)) for cq in loose])
+        else:
+            for cq in loose:
                 update_cluster_queue_resource_node(cq)
         self._update_all_statuses()
 

@@ -96,6 +96,12 @@ class Driver:
             pods_ready_requeuing_timestamp=self.wait_for_pods_ready.requeuing_timestamp)
         self.cache = Cache(info_options=info_options,
                            fair_sharing_enabled=fair_sharing)
+        # parallel host apply/pack plane (utils/parallel_host.py):
+        # KUEUE_TPU_HOST_WORKERS>=2 fans the post-cycle host work out by
+        # cohort forest; the default (0) is the bit-identical serial arm
+        from ..utils.parallel_host import host_pool_from_env
+        self.host_pool = host_pool_from_env()
+        self.cache.host_pool = self.host_pool
         self.queues = QueueManager(ordering=ordering, clock=clock,
                                    info_options=info_options)
         self.scheduler = Scheduler(
@@ -255,7 +261,8 @@ class Driver:
                 names, self._bulk_applied_cqs = \
                     self._bulk_applied_cqs, None
                 self._sync_cq_activeness()
-                self.queues.queue_inadmissible_workloads(names)
+                self.queues.queue_inadmissible_workloads(
+                    names, pool=self.host_pool)
                 for name in names:
                     cq = self.cache.cluster_queue(name)
                     if cq is not None:
@@ -294,7 +301,8 @@ class Driver:
                 seen: set = set()
                 names = [n for n in touched
                          if not (n in seen or seen.add(n))]
-                self.queues.queue_inadmissible_workloads(names)
+                self.queues.queue_inadmissible_workloads(
+                    names, pool=self.host_pool)
         return _ctx()
 
     def _drain_cluster_queue(self, cq_name: str) -> None:
@@ -400,8 +408,15 @@ class Driver:
         """Attach a write-ahead cycle journal (utils.journal.CycleWAL):
         every admit/evict/requeue/finish decision is journaled before
         the store mutation it describes, and each cycle's batch is
-        committed at the cycle boundary."""
+        committed at the cycle boundary.  The host pool announces its
+        workers to a sharded WAL so segment striping engages (and the
+        per-segment commit flushes fan out); with the pool inactive the
+        sharded WAL collapses to one hot segment."""
+        if self._wal is not None:
+            self.host_pool.detach_wal(self._wal)
         self._wal = wal
+        if wal is not None:
+            self.host_pool.attach_wal(wal)
 
     def recover_from(self, stored, wal=None) -> int:
         """Crash recovery (SURVEY §5.4 + the WAL): roll the journal's
@@ -490,7 +505,7 @@ class Driver:
         if any_done:
             self.wake_gate_blocked()
         if self._wal is not None:
-            self._wal.commit()
+            self.host_pool.commit_wal(self._wal)
 
     def update_reclaimable_pods(self, key: str, counts: dict[str, int]) -> None:
         """reference workload.UpdateReclaimablePods (KEP 78): shrink the
@@ -854,7 +869,7 @@ class Driver:
             stats = self.scheduler.schedule()
         self.metrics.admission_attempt(bool(stats.admitted), stats.duration_s)
         if self._wal is not None:
-            self._wal.commit()
+            self.host_pool.commit_wal(self._wal)
         self.obs.record_cycle(stats)
         return stats
 
@@ -955,7 +970,7 @@ class Driver:
                 self.finish_workloads(batch)
             stats.finish_s = _time.perf_counter() - t0
             if self._wal is not None:
-                self._wal.commit()
+                self.host_pool.commit_wal(self._wal)
             self.obs.record_cycle(stats)
             if on_cycle is not None:
                 on_cycle(k, stats)
@@ -1439,8 +1454,18 @@ class Driver:
                 "agg_cqs_compressible") if k in bs}
             if agg:
                 out["agg"] = agg
+            # head-only packing block: rows charged to the kernel's
+            # 2^19 composite-key budget vs budget-exempt rank context
+            hp = {k: bs[k] for k in (
+                "head_pack_budget_rows", "head_pack_exempt_rows")
+                if k in bs}
+            if hp:
+                out["head_pack"] = hp
         from ..utils.heap import REPAIR_STATS
         out["heap_repair"] = dict(REPAIR_STATS)
+        from ..utils.parallel_host import POOL_STATS
+        out["host_pool"] = dict(POOL_STATS,
+                                host_pool_workers=self.host_pool.workers)
         if self._wal is not None and hasattr(self._wal, "stats"):
             out["wal"] = dict(self._wal.stats)
             if "wal_shards" in out["wal"]:
@@ -1462,7 +1487,9 @@ class Driver:
                                          out.get("flavor_walk"))
         self.metrics.pack_sample(out.get("pack"), out.get("wal"))
         self.metrics.scale_opt_sample(out.get("agg"), out["heap_repair"],
-                                      out.get("wal_shard"))
+                                      out.get("wal_shard"),
+                                      out.get("head_pack"),
+                                      out["host_pool"])
         out["obs"] = self.obs.report()
         return out
 

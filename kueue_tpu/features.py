@@ -180,6 +180,15 @@ ENV_FLAGS: dict[str, EnvFlag] = {f.name: f for f in (
     EnvFlag("KUEUE_TPU_WAL_SHARDS", "1", "int",
             "CycleWAL segment count; >1 stripes group-commit across "
             "that many journal files with merged total-order replay."),
+    EnvFlag("KUEUE_TPU_HEAD_PACK", "1", "bool",
+            "Head-only packing: charge the kernel's 2^19 composite-key "
+            "row budget (uid rank + poison gates) only to rows of "
+            "forests that can preempt; pending rows of never-preempting "
+            "forests ride along as rank context outside the budget."),
+    EnvFlag("KUEUE_TPU_HOST_WORKERS", "0", "int",
+            "Worker threads for the parallel host apply/pack plane "
+            "(cache rebuild fan-out, dirty-CQ pack walk, requeue "
+            "wakeups, WAL shard appends); 0 or 1 = serial."),
 )}
 
 

@@ -299,12 +299,16 @@ class Registry:
                     self.set_gauge(gauge, (), float(wal_stats[k]))
 
     def scale_opt_sample(self, agg_stats=None, heap_stats=None,
-                         wal_shard_stats=None) -> None:
+                         wal_shard_stats=None, head_pack_stats=None,
+                         host_pool_stats=None) -> None:
         """Publish the 1M-CQ scale-path telemetry: cohort-forest
         aggregate compression (``kueue_agg_*``, ops/aggregate.py), lazy
-        heap repair (``kueue_heap_repair_*``, utils/heap.py), and
-        sharded WAL striping (``kueue_wal_shard_*``, utils/journal.py).
-        Sampled by ``Driver.stats`` like the pack/WAL series."""
+        heap repair (``kueue_heap_repair_*``, utils/heap.py), sharded
+        WAL striping (``kueue_wal_shard_*``, utils/journal.py),
+        head-only packing (``kueue_head_pack_*``, ops/burst.py budget
+        scoping), and the parallel host plane (``kueue_host_pool_*``,
+        utils/parallel_host.py).  Sampled by ``Driver.stats`` like the
+        pack/WAL series."""
         agg_gauge_of = {
             "agg_rows_compressed": "kueue_agg_rows_compressed",
             "agg_rows_packed": "kueue_agg_rows_packed",
@@ -321,6 +325,16 @@ class Registry:
             "wal_shards": "kueue_wal_shards",
             "wal_shard_skew": "kueue_wal_shard_skew",
         }
+        head_pack_gauge_of = {
+            "head_pack_budget_rows": "kueue_head_pack_budget_rows",
+            "head_pack_exempt_rows": "kueue_head_pack_exempt_rows",
+        }
+        pool_gauge_of = {
+            "host_pool_workers": "kueue_host_pool_workers",
+            "host_pool_tasks": "kueue_host_pool_tasks",
+            "host_pool_batches": "kueue_host_pool_batches",
+            "host_pool_partitions": "kueue_host_pool_partitions",
+        }
         if agg_stats:
             for k, gauge in agg_gauge_of.items():
                 if k in agg_stats:
@@ -333,6 +347,14 @@ class Registry:
             for k, gauge in shard_gauge_of.items():
                 if k in wal_shard_stats:
                     self.set_gauge(gauge, (), float(wal_shard_stats[k]))
+        if head_pack_stats:
+            for k, gauge in head_pack_gauge_of.items():
+                if k in head_pack_stats:
+                    self.set_gauge(gauge, (), float(head_pack_stats[k]))
+        if host_pool_stats:
+            for k, gauge in pool_gauge_of.items():
+                if k in host_pool_stats:
+                    self.set_gauge(gauge, (), float(host_pool_stats[k]))
 
     def report_weighted_share(self, cq: str, share: float) -> None:
         self.set_gauge("kueue_cluster_queue_weighted_share", (cq,), share)
@@ -595,6 +617,21 @@ _SERIES_DEFS = [
      "Configured CycleWAL segment count (1 = unsharded)."),
     ("kueue_wal_shard_skew", "gauge", (),
      "Max-minus-min appended ops across WAL segments."),
+    # r19 scale path: head-only packing + parallel host plane
+    ("kueue_head_pack_budget_rows", "gauge", (),
+     "Packed rows charged against the kernel's 2^19 composite-key "
+     "budget (rows of preempting forests)."),
+    ("kueue_head_pack_exempt_rows", "gauge", (),
+     "Packed rows exempt from the composite-key budget (rank context "
+     "of never-preempting forests)."),
+    ("kueue_host_pool_workers", "gauge", (),
+     "Configured host-plane worker threads (0/1 = serial)."),
+    ("kueue_host_pool_tasks", "gauge", (),
+     "Tasks executed on host-pool worker threads."),
+    ("kueue_host_pool_batches", "gauge", (),
+     "Fork-join rounds the host pool fanned out."),
+    ("kueue_host_pool_partitions", "gauge", (),
+     "Cohort-forest partitions dispatched by the host pool."),
     # observability plane (obs/)
     ("kueue_span_duration_seconds", "histogram", ("phase",),
      "Traced hot-path phase durations (obs tracer), wall seconds."),

@@ -30,6 +30,7 @@ output opens in Perfetto next to ``jax.profiler`` traces from
 
 from __future__ import annotations
 
+import threading
 import time
 from dataclasses import dataclass
 from typing import Callable, Optional
@@ -172,6 +173,12 @@ class Tracer:
                  vclock: Optional[Callable[[], float]] = None):
         self.registry = registry if registry is not None else Registry()
         self.vclock = vclock
+        # the tracer's span stack belongs to the thread that built it
+        # (the driver coordinator); spans opened from host-pool worker
+        # threads are handed the no-op — their work is timed inside
+        # the coordinator's enclosing span, and a shared LIFO stack
+        # cannot absorb concurrent closes
+        self._owner = threading.get_ident()
         self._stack: list[Span] = []
         self._pool: list[Span] = []      # one reusable span per depth
         self._counted = _CountedSpan(self)   # shared histogram-only leaf
@@ -272,9 +279,17 @@ def span(name: str, counted: bool = False):
     operation itself is ~2µs, so a retained record would out-cost it):
     every entry is still timed into the phase histogram — roster
     counts and percentiles stay exact — but no SpanRecord lands in the
-    cycle buffer or the Chrome trace."""
+    cycle buffer or the Chrome trace.
+
+    Calls from a thread other than the tracer's owner (host-pool
+    workers fanning WAL segment commits or pack-walk partitions) get
+    the no-op: the shared LIFO span stack is single-threaded by
+    design, and pooled work is already timed by the coordinator's
+    enclosing span."""
     t = ACTIVE
-    return t.span(name, counted) if t is not None else _NOOP
+    if t is None or threading.get_ident() != t._owner:
+        return _NOOP
+    return t.span(name, counted)
 
 
 def to_chrome_trace(spans) -> dict:

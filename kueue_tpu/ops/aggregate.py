@@ -47,6 +47,27 @@ def agg_planes_enabled() -> bool:
     return env_value("KUEUE_TPU_AGG_PLANES") != "0"
 
 
+def head_pack_enabled() -> bool:
+    """Head-only packing (``KUEUE_TPU_HEAD_PACK``, default on).
+
+    The same forest census that makes admitted rows compressible makes
+    *pending* rows budget-exempt: a pending row of a never-preempting
+    forest can win its own CQ's head slot (a per-CQ lexsort, no
+    composite key involved) but can never be gathered as a preemption
+    candidate — candidate eligibility requires the head CQ's
+    ``wcq_lower``/``rwc_enabled``, which no member of such a forest
+    has, and ineligible candidates sort behind every eligible one via
+    key_hi bit 30.  So the kernel's 19-bit uid rank and the 2^19/2^20
+    poison gates only need to cover rows of *preempting* forests
+    ("budget rows"); everything else rides along as rank context.
+    Kernel row *budget* then scales with preempting-forest rows, not
+    active CQs — the r19 ceiling lift.  The scoped uid rank is the
+    subset rank (order-preserving), so candidate ordering — hence every
+    decision — is bit-identical to the row-backed arm (test-enforced
+    in tests/test_head_packing.py)."""
+    return env_value("KUEUE_TPU_HEAD_PACK") != "0"
+
+
 def compressible_cqs(statics) -> np.ndarray:
     """[C] bool: CQ sits in a forest no member of which can preempt.
 

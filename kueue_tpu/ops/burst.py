@@ -934,6 +934,11 @@ class BurstPlan:
     prev_token: Optional[int] = None
     dirty_cqs: Optional[np.ndarray] = None   # None = full walk
     dirty_ranges: Optional[list] = None      # coalesced [lo, hi) rows
+    # head-pack accounting: rows charged against the kernel's 2^19
+    # composite-key budget vs total rows packed into the [C, M] grid
+    # (budget_rows == grid_rows when KUEUE_TPU_HEAD_PACK=0)
+    budget_rows: int = 0
+    grid_rows: int = 0
 
 
 def build_candidate_tables(forest_of_cq: np.ndarray, members: np.ndarray,
@@ -1547,9 +1552,25 @@ def _assemble_plan(st, records, cache, scheduler, min_m,
     crank = np.empty(n, dtype=np.int64)
     crank[np.lexsort((key_arr, pos_a, ts_a, -prio_a))] = np.arange(n)
     # uid rank (candidatesOrdering final tiebreak) + reservation-time
-    # dense rank (ties share a value; uid breaks them separately)
-    uidrank = np.empty(n, dtype=np.int64)
-    uidrank[np.argsort(uid_arr, kind="stable")] = np.arange(n)
+    # dense rank (ties share a value; uid breaks them separately).
+    # Head-pack mode scopes the rank to budget rows — rows of forests
+    # that can preempt (~comp_cq); the rest can never be candidate-
+    # gathered (see aggregate.head_pack_enabled), so their uidrank
+    # cells are never read and the subset rank preserves the eligible
+    # ordering bit for bit while freeing the 19-bit field's range.
+    from .aggregate import head_pack_enabled
+    head_pack = head_pack_enabled()
+    uidrank = np.zeros(n, dtype=np.int64)
+    if head_pack:
+        bidx = np.nonzero(~s.comp_cq[ci_a])[0]
+        uidrank[bidx[np.argsort(uid_arr[bidx], kind="stable")]] = \
+            np.arange(len(bidx))
+        n_budget = int(len(bidx))
+        prio_budget = (int(np.abs(prio_a[bidx]).max()) if n_budget else 0)
+    else:
+        uidrank[np.argsort(uid_arr, kind="stable")] = np.arange(n)
+        n_budget = n
+        prio_budget = int(np.abs(prio_a).max(initial=0))
     uniq_ts = np.unique(res_ts_a[adm_a]) if adm_a.any() else np.empty(0)
     seq_a = np.zeros(n, dtype=np.int64)
     if len(uniq_ts):
@@ -1601,10 +1622,14 @@ def _assemble_plan(st, records, cache, scheduler, min_m,
     # the kernel's composite candidate-ordering keys pack priority and
     # reservation-seq into 20-bit fields and uid rank into 19; in-burst
     # admissions consume seq_base..seq_base+K-1, so the headroom is the
-    # largest window the ladder can dispatch (not a hardcoded constant)
-    if (np.abs(prio_a).max(initial=0) >= (1 << 20)
+    # largest window the ladder can dispatch (not a hardcoded constant).
+    # Only budget rows (rows the candidate keys can ever encode) are
+    # charged against the 2^19/2^20 fields; the seq gate stays global
+    # because reservation seqs are dense over distinct admitted
+    # timestamps regardless of forest.
+    if (prio_budget >= (1 << 20)
             or seq_base + max(K_BURST_LADDER) >= (1 << 20)
-            or n >= (1 << 19)):
+            or n_budget >= (1 << 19)):
         forest_bad[:] = True
     preempt_ok = s.modelable_base & ~forest_bad[forest_of_cq]
     # pure function of the structure statics + (M, KC); M is sticky
@@ -1646,7 +1671,8 @@ def _assemble_plan(st, records, cache, scheduler, min_m,
     return BurstPlan(structure=st, arrays=arrays, keys=keys,
                      C=C, M=M, L=L, G=G, n_levels=s.n_levels, KC=KC,
                      seq_base=seq_base, row_of_key=row_of_key,
-                     max_res_ts=max_res_ts)
+                     max_res_ts=max_res_ts,
+                     budget_rows=n_budget, grid_rows=n)
 
 
 def pack_burst(structure, queues, cache, scheduler, clock,

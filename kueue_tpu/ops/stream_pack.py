@@ -414,9 +414,19 @@ def _materialize(st, state, s, views, scheduler, dirty_cis, prev_token,
         forest_bad[:] = True
     if not scheduler.ordering.priority_sorting_within_cohort:
         forest_bad[:] = True
-    if (int(state.maxabs_prio_cq.max(initial=0)) >= (1 << 20)
+    # budget scoping mirrors _assemble_plan: with head-pack on, only
+    # rows of preempting forests can ever be candidate-encoded, so only
+    # they are charged against the 19/20-bit composite-key fields
+    if _agg.head_pack_enabled():
+        bm = ~s.comp_cq
+        n_budget = int(state.n_rows_cq[bm].sum())
+        prio_budget = int(state.maxabs_prio_cq[bm].max(initial=0))
+    else:
+        n_budget = n
+        prio_budget = int(state.maxabs_prio_cq.max(initial=0))
+    if (prio_budget >= (1 << 20)
             or seq_base + max(_b.K_BURST_LADDER) >= (1 << 20)
-            or n >= (1 << 19)):
+            or n_budget >= (1 << 19)):
         forest_bad[:] = True
     preempt_ok = s.modelable_base & ~forest_bad[s.forest_of_cq]
     tables = s.cand_tables.get((M, KC))
@@ -449,7 +459,8 @@ def _materialize(st, state, s, views, scheduler, dirty_cis, prev_token,
         keys=_KeysView(views["keys_grid"].copy()),
         C=C, M=M, L=L, G=G, n_levels=s.n_levels, KC=KC,
         seq_base=seq_base, row_of_key=state.row_of_key,
-        max_res_ts=max_res_ts)
+        max_res_ts=max_res_ts,
+        budget_rows=n_budget, grid_rows=n)
     plan.pack_token = state.token
     plan.prev_token = prev_token
     if dirty_cis is not None:
@@ -465,6 +476,8 @@ def _materialize(st, state, s, views, scheduler, dirty_cis, prev_token,
         stats.update({("pack_" + k): v
                       for k, v in state.arena.stats.items()})
         stats.update(_agg.agg_summary(state, s.comp_cq))
+        stats["head_pack_budget_rows"] = n_budget
+        stats["head_pack_exempt_rows"] = n - n_budget
     return plan
 
 
@@ -615,11 +628,20 @@ def _init_full(st, queues, cache, scheduler, key, min_m, window, arena,
     if n:
         views["wl_cycle_rank"][state.crank.ci, state.crank.mi] = \
             np.arange(n, dtype=np.int32)
+    # head-pack: the uid order (and so the 19-bit uidrank field) only
+    # tracks budget rows — rows of preempting forests; exempt rows keep
+    # the pad rank 0, which the kernel never reads for them (candidate
+    # eligibility needs the head's wcq_lower/rwc_enabled census bits)
     state.uord = _Order(f"S{_UID_BYTES}")
-    state.uord.set(ub_all, ci_a, mi_a)
-    if n:
+    if _agg.head_pack_enabled() and n:
+        bsel = np.nonzero(~s.comp_cq[ci_a])[0]
+        state.uord.set(ub_all[bsel], ci_a[bsel], mi_a[bsel])
+    else:
+        state.uord.set(ub_all, ci_a, mi_a)
+    n_uord = len(state.uord.ci)
+    if n_uord:
         views["wl_uidrank"][state.uord.ci, state.uord.mi] = \
-            np.arange(n, dtype=np.int32)
+            np.arange(n_uord, dtype=np.int32)
     am = np.nonzero(adm_a)[0]
     ats = res_ts_a[am]
     aord = np.argsort(ats, kind="stable")
@@ -751,21 +773,37 @@ def pack_burst_streaming(structure, queues, cache, scheduler, clock,
         statics = _b._pack_statics(st, cache)
         comp_cq = (statics.comp_cq if _agg.agg_planes_enabled()
                    else None)
-        walked = []
-        for name in dirty:
-            ci = index_of.get(name)
-            if ci is None:
-                continue
+        def _walk_one(ci):
             rec = _b._pack_cq_rows(st, ci, int(state.pos_cq[ci]),
                                    queues, cache, scheduler, assumed,
                                    scale_of, window,
                                    compress=(comp_cq is not None
                                              and bool(comp_cq[ci])))
             if rec is _b._PACK_FAIL:
-                return None, None, False
+                return None
             kb = _enc_str(rec.keys, _KEY_BYTES)
             ub = _enc_str(rec.uids, _UID_BYTES)
-            walked.append((ci, rec, kb, ub, _cq_mi(rec)))
+            return (ci, rec, kb, ub, _cq_mi(rec))
+
+        cis = sorted(ci for name in dirty
+                     if (ci := index_of.get(name)) is not None)
+        # stage A is per-CQ pure (each walk reads shared structure and
+        # writes only its own CQ's rows/memos), so the host pool fans
+        # the dirty walk out by cohort forest; the gather is in
+        # ascending (forest, ci) order, and every downstream merge is
+        # order-insensitive (sorted-order updates, disjoint row writes),
+        # so pooled and serial walks build identical states
+        pool = getattr(cache, "host_pool", None)
+        if pool is not None and pool.active and len(cis) >= 2:
+            fcq = statics.forest_of_cq
+            parts = pool.map_partitions(
+                cis, lambda ci: int(fcq[ci]),
+                lambda g, part: [_walk_one(ci) for ci in part])
+            walked = [w for part in parts for w in part]
+        else:
+            walked = [_walk_one(ci) for ci in cis]
+        if any(w is None for w in walked):
+            return None, None, False
 
         for ci, rec, kb, ub, mi in walked:
             state.n_rows_cq[ci] = rec.n_rows
@@ -824,10 +862,13 @@ def pack_burst_streaming(structure, queues, cache, scheduler, clock,
                 np.arange(sfrom, ntot, dtype=np.int32)
             rank_patches += ntot - sfrom
 
-        # uid rank: same mechanism, dirty CQs only
+        # uid rank: same mechanism, dirty CQs only; head-pack keeps
+        # exempt (never-candidate) CQs out of the maintained uid order,
+        # mirroring the _init_full budget filter
+        head_pack = _agg.head_pack_enabled()
         ins_sk, ins_ci, ins_mi = [], [], []
         for ci, rec, kb, ub, mi in walked:
-            if rec.n_rows:
+            if rec.n_rows and not (head_pack and statics.comp_cq[ci]):
                 ins_sk.append(ub)
                 ins_ci.append(np.full(rec.n_rows, ci, np.int32))
                 ins_mi.append(mi)

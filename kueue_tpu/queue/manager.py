@@ -234,9 +234,16 @@ class Manager:
     # Cohort-wide wakeups — reference manager.go:490
     # ------------------------------------------------------------------
 
-    def queue_inadmissible_workloads(self, cq_names: Iterable[str]) -> None:
+    def queue_inadmissible_workloads(self, cq_names: Iterable[str],
+                                     pool=None) -> None:
         """Move parked workloads back for these CQs and everything sharing
-        their cohort trees (quota may have freed anywhere in the tree)."""
+        their cohort trees (quota may have freed anywhere in the tree).
+
+        ``pool`` (a ``HostPool``) fans the per-queue unpark passes out
+        across workers: each pass touches only that queue's parked set
+        and heap, so queues are the natural partition; the gather is in
+        sorted-name order so the storm counters and unpark results are
+        identical to the serial walk."""
         with self._lock:
             names = set()
             for name in cq_names:
@@ -245,11 +252,14 @@ class Manager:
                 if parent is not None:
                     for cq_name in (q.name for q in parent.root().subtree_cqs()):
                         names.add(cq_name)
-            moved = 0
-            for name in names:
-                q = self._mgr.cluster_queues.get(name)
-                if q is not None:
-                    moved += q.queue_inadmissible_workloads()
+            queues = [q for name in sorted(names)
+                      if (q := self._mgr.cluster_queues.get(name)) is not None]
+            if pool is not None and pool.active and len(queues) >= 2:
+                moved = sum(pool.run(
+                    [q.queue_inadmissible_workloads for q in queues]))
+            else:
+                moved = sum(q.queue_inadmissible_workloads()
+                            for q in queues)
             if moved:
                 self.requeue_storm_last = moved
                 self.requeue_storm_peak = max(self.requeue_storm_peak, moved)

@@ -279,6 +279,12 @@ class CycleWAL:
 
     # -- writing --
 
+    def register_appender(self, name) -> None:
+        """No-op; duck-compat with ShardedCycleWAL's appender census."""
+
+    def unregister_appender(self, name) -> None:
+        """No-op; duck-compat with ShardedCycleWAL's appender census."""
+
     def log(self, op: dict) -> None:
         from ..obs.trace import span as _span
         # counted leaf: per-op appends are ~2µs, a retained record
@@ -453,6 +459,14 @@ class ShardedCycleWAL:
     autodetects them.  ``wal.shard_merge`` is the chaos crashpoint
     between per-segment compactions: a crash there leaves segments at
     mixed compaction generations, which the merged replay must absorb.
+
+    Striping only pays when appenders are actually concurrent; with a
+    single writer it spreads one stream across K buffered files and
+    *loses* (0.84x commit wall in SCALE_r18.json).  Appenders therefore
+    announce themselves via ``register_appender``/``unregister_appender``
+    (the host worker pool does this), and with <=1 registered the router
+    collapses every op to segment 0 — single-stream locality — while the
+    seq stamp keeps the merged replay identical either way.
     """
 
     def __init__(self, path: Optional[str] = None, shards: int = 2,
@@ -466,21 +480,39 @@ class ShardedCycleWAL:
                      compact_every=compact_every)
             for i in range(self.shards)]
         self._seq = 0
+        self._appenders: set = set()
 
     @staticmethod
     def shard_path(path: str, i: int) -> str:
         return f"{path}.s{i:02d}"
 
+    def register_appender(self, name) -> None:
+        """Announce a concurrent appender; striping engages at >=2."""
+        self._appenders.add(name)
+
+    def unregister_appender(self, name) -> None:
+        self._appenders.discard(name)
+
     def _route(self, op: dict) -> int:
+        if len(self._appenders) <= 1:
+            return 0   # single writer: keep one hot stream (no stripe tax)
         key = op.get("key") or (op.get("keys") or ("",))[0]
         return zlib.crc32(key.encode("utf-8", "replace")) % self.shards
 
     # -- writing --
 
     def log(self, op: dict) -> None:
-        seq = self._seq
+        # stamp seq in place: CycleWAL.log stores the caller's dict by
+        # reference anyway (ownership passes to the journal), and the
+        # per-op copy was most of the single-appender stripe tax the
+        # r19 collapse is meant to remove; the route branch is inlined
+        # because a single hot stream takes it 100% of the time
+        op["seq"] = self._seq
         self._seq += 1
-        self._shards[self._route(op)].log(dict(op, seq=seq))
+        if len(self._appenders) <= 1:
+            self._shards[0].log(op)   # single writer: one hot stream
+        else:
+            self._shards[self._route(op)].log(op)
 
     def commit(self) -> None:
         for sh in self._shards:
@@ -520,6 +552,7 @@ class ShardedCycleWAL:
                 out[k] += sh.stats[k]
         out["wal_shards"] = self.shards
         out["wal_shard_skew"] = max(appends) - min(appends)
+        out["wal_appenders"] = len(self._appenders)
         return out
 
     @classmethod
@@ -529,6 +562,7 @@ class ShardedCycleWAL:
         wal = cls.__new__(cls)
         wal.path = path
         wal._shards = []
+        wal._appenders = set()
         i = 0
         while os.path.exists(cls.shard_path(path, i)):
             wal._shards.append(CycleWAL.load(cls.shard_path(path, i)))

@@ -1,0 +1,141 @@
+"""Parallel host apply/pack plane: a deterministic fork-join pool.
+
+The r18 residue ledger measured the host apply/pack path as the last
+serial bottleneck (~1.4k workloads/s on one core while the sharded WAL
+sustains 83k append/s).  ``HostPool`` is the worker-pool executor the
+driver threads through the post-cycle host work — the cache-rebuild
+root fan-out, the dirty-CQ pack walk, the requeue-wakeup pass, and the
+per-segment WAL group-commit flushes — partitioned by cohort forest,
+the natural no-shared-state key (the same partition the ``("cq",)``
+mesh shards by): no two forests share a resource node, an arena row
+range, or a quota pool, so partition tasks never race.
+
+Determinism is structural, not lock-based: work is submitted as an
+ordered list of independent tasks and results are gathered **in
+submission order** (ascending forest id for ``map_partitions``),
+whatever order the OS scheduler finishes them in.  WAL ordering is
+likewise structural: op seq numbers are stamped serially by the
+coordinator in decision order *before* any fan-out, so the seq-merged
+sharded replay is byte-identical to the serial path; the pool only
+parallelizes the per-segment ``commit`` flush/fsync (which release the
+GIL) and registers its workers with the sharded WAL so hash striping
+engages.  Decisions are therefore bit-identical to the serial control
+— test-enforced in tests/test_parallel_host.py and the SCALE_r19 arms.
+
+``workers <= 1`` (the ``KUEUE_TPU_HOST_WORKERS`` default) never builds
+a thread: every entry point degrades to the plain serial loop, so the
+serial path stays the zero-surprise control arm.
+"""
+
+from __future__ import annotations
+
+from concurrent.futures import ThreadPoolExecutor
+from typing import Callable, Iterable, Optional, Sequence, TypeVar
+
+from ..features import env_int
+
+T = TypeVar("T")
+
+# process-wide counters (kueue_host_pool_* metrics)
+POOL_STATS = {
+    "host_pool_tasks": 0,          # tasks executed on pool threads
+    "host_pool_serial_tasks": 0,   # tasks the pool ran inline (serial
+    #                                mode, or batches of one)
+    "host_pool_batches": 0,        # fork-join rounds that fanned out
+    "host_pool_partitions": 0,     # forest partitions dispatched
+    "host_pool_wal_commits": 0,    # per-segment commit flushes fanned out
+}
+
+
+class HostPool:
+    """Fork-join executor with deterministic, submission-order gather."""
+
+    def __init__(self, workers: int = 0):
+        self.workers = max(0, int(workers))
+        self._ex: Optional[ThreadPoolExecutor] = None
+
+    @property
+    def active(self) -> bool:
+        return self.workers >= 2
+
+    def _executor(self) -> ThreadPoolExecutor:
+        if self._ex is None:
+            self._ex = ThreadPoolExecutor(
+                max_workers=self.workers,
+                thread_name_prefix="kueue-host")
+        return self._ex
+
+    def run(self, thunks: Sequence[Callable[[], T]]) -> list[T]:
+        """Run independent thunks, return results in submission order.
+
+        All thunks run to completion even when one raises (a half-done
+        sibling mutating in the background after an early re-raise
+        would be a race); the first exception in submission order is
+        then re-raised — same observable behavior as the serial loop.
+        """
+        if not self.active or len(thunks) < 2:
+            POOL_STATS["host_pool_serial_tasks"] += len(thunks)
+            return [fn() for fn in thunks]
+        POOL_STATS["host_pool_batches"] += 1
+        POOL_STATS["host_pool_tasks"] += len(thunks)
+        futures = [self._executor().submit(fn) for fn in thunks]
+        out, first_err = [], None
+        for fut in futures:               # submission order, not as_completed
+            try:
+                out.append(fut.result())
+            except BaseException as exc:  # noqa: BLE001 - must drain all
+                if first_err is None:
+                    first_err = exc
+                out.append(None)
+        if first_err is not None:
+            raise first_err
+        return out
+
+    def map_partitions(self, items: Iterable[T],
+                       key_fn: Callable[[T], object],
+                       fn: Callable[[object, list[T]], object]) -> list:
+        """Partition ``items`` by ``key_fn`` (ascending key = forest id
+        order), run ``fn(key, partition)`` per partition, and return the
+        per-partition results in key order."""
+        parts: dict = {}
+        for it in items:
+            parts.setdefault(key_fn(it), []).append(it)
+        keys = sorted(parts, key=repr)
+        POOL_STATS["host_pool_partitions"] += len(keys)
+        results = self.run([
+            (lambda k=k: fn(k, parts[k])) for k in keys])
+        return results
+
+    # -- WAL plumbing -------------------------------------------------------
+
+    def attach_wal(self, wal) -> None:
+        """Announce the pool's workers to a (possibly sharded) WAL so
+        segment striping engages; no-op census on the single-file WAL."""
+        for i in range(self.workers if self.active else 0):
+            wal.register_appender(f"host-pool-w{i}")
+
+    def detach_wal(self, wal) -> None:
+        for i in range(self.workers if self.active else 0):
+            wal.unregister_appender(f"host-pool-w{i}")
+
+    def commit_wal(self, wal) -> None:
+        """Group-commit ``wal``: per-segment flushes fan out across the
+        pool (file write/flush/fsync release the GIL).  Seq stamps were
+        assigned serially at append time, so the merged order is already
+        fixed — this only parallelizes the I/O."""
+        segments = getattr(wal, "_shards", None)
+        if not self.active or not segments:
+            wal.commit()
+            return
+        POOL_STATS["host_pool_wal_commits"] += 1
+        self.run([sh.commit for sh in segments])
+
+    def close(self) -> None:
+        if self._ex is not None:
+            self._ex.shutdown(wait=True)
+            self._ex = None
+
+
+def host_pool_from_env() -> HostPool:
+    """The driver's pool factory, wired to ``KUEUE_TPU_HOST_WORKERS``."""
+    return HostPool(env_int("KUEUE_TPU_HOST_WORKERS"))

@@ -138,6 +138,73 @@ def test_lazy_heap_settle_counters_and_bulk_path():
     assert ds["heap_repair_settles"] - before["heap_repair_settles"] == 2
 
 
+def test_adaptive_demotes_at_one_touch_per_key():
+    """Low-churn regime (every key touched once between ordered reads):
+    the adaptive gate must demote to eager sifts — the r18 microbench
+    showed the overlay is a 0.83x loss here — while keeping the pop
+    order identical to a plain eager heap."""
+    before = dict(heap_mod.REPAIR_STATS)
+    eager, lazy = make_pair()
+    rng = random.Random(7)
+    serial = 0
+    for cycle in range(40):
+        for _ in range(16):                  # 16 distinct fresh keys,
+            it = Item(f"u{serial}", rng.choice([0, 10, 50]),
+                      round(rng.random() * 100, 3))
+            serial += 1
+            eager.push_or_update(it)
+            lazy.push_or_update(Item(it.key, it.prio, it.ts))
+        a, b = eager.pop(), lazy.pop()       # one touch each -> read
+        assert (a.key, a.prio, a.ts) == (b.key, b.prio, b.ts)
+    assert lazy._lazy_active is False, \
+        "sustained 1 touch/key must demote the overlay"
+    assert lazy._touch_ewma < heap_mod._ADAPT_THRESHOLD
+    ds = heap_mod.REPAIR_STATS
+    assert ds["heap_repair_eager_ops"] > before["heap_repair_eager_ops"]
+    assert ds["heap_repair_mode_flips"] > before["heap_repair_mode_flips"]
+    # full drain parity after the demotion
+    while True:
+        a, b = eager.pop(), lazy.pop()
+        assert (a is None) == (b is None)
+        if a is None:
+            break
+        assert (a.key, a.prio, a.ts) == (b.key, b.prio, b.ts)
+
+
+def test_adaptive_repromotes_when_churn_returns():
+    """After a demotion, a storm that re-touches the same keys many
+    times between reads must flip the heap back to lazy deferral."""
+    _, lazy = make_pair()
+    rng = random.Random(11)
+    serial = 0
+    for cycle in range(40):                  # drive it eager first
+        for _ in range(16):
+            lazy.push_or_update(Item(f"u{serial}", 10, float(serial)))
+            serial += 1
+        lazy.peek()
+    assert lazy._lazy_active is False
+    for cycle in range(40):                  # 8 touches/key regime
+        for _ in range(128):
+            k = f"hot{rng.randrange(16)}"
+            lazy.push_or_update(Item(k, rng.choice([0, 10, 50]),
+                                     round(rng.random() * 100, 3)))
+        lazy.peek()
+    assert lazy._lazy_active is True, \
+        "high touches-per-key must re-promote lazy deferral"
+    assert lazy._touch_ewma >= heap_mod._ADAPT_THRESHOLD
+
+
+def test_adaptive_never_flips_with_live_overlay():
+    """Mode transitions only happen with an empty overlay, so buffered
+    items can never be stranded un-settled."""
+    _, lazy = make_pair()
+    lazy._touch_ewma = 0.0                   # force "wants eager"
+    lazy.push_or_update(Item("a", 10, 1.0))  # buffered while still lazy
+    assert lazy._lazy_active is True and lazy.get("a") is not None
+    assert lazy.peek().key == "a"            # settle applies the overlay
+    assert not lazy._pending
+
+
 def test_cluster_queue_storm_parity_lazy_vs_eager(monkeypatch):
     """The driver-level wiring: a ClusterQueueQueue built with the flag
     on must pop the identical head sequence as one built with it off,

@@ -1,0 +1,213 @@
+"""Parallel host apply/pack plane: deterministic fork-join semantics.
+
+``HostPool`` parallelizes the host-side walls — cache rebuild fan-out,
+the stage-A dirty-CQ pack walk, per-queue requeue wakeups, and sharded
+WAL segment commits — without ever changing a decision: partitions are
+disjoint (per-forest, per-queue, per-segment), results are gathered in
+submission order, and WAL ``seq`` stamps are assigned serially by the
+coordinator before any fan-out, so the merged replay is byte-identical
+to the serial arm.  These tests pin the executor contract (serial
+fallback, ordering, exception draining, partition ordering), the WAL
+appender-registration handshake that engages segment striping, and
+twin-driver decision/replay parity at 0 vs 4 workers.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+
+import pytest
+
+from kueue_tpu.utils.journal import ShardedCycleWAL
+from kueue_tpu.utils.parallel_host import (
+    POOL_STATS,
+    HostPool,
+    host_pool_from_env,
+)
+
+from test_aggregate_compression import build_mixed
+from test_delta_pack import mk
+
+
+# ---------------------------------------------------------------------------
+# Executor contract
+# ---------------------------------------------------------------------------
+
+def test_inactive_pool_runs_inline():
+    for w in (0, 1):
+        pool = HostPool(w)
+        assert pool.active is False
+        before = POOL_STATS["host_pool_serial_tasks"]
+        out = pool.run([lambda: 1, lambda: 2, lambda: 3])
+        assert out == [1, 2, 3]
+        assert POOL_STATS["host_pool_serial_tasks"] == before + 3
+        pool.close()
+
+
+def test_run_gathers_in_submission_order():
+    pool = HostPool(4)
+    assert pool.active
+    try:
+        def slow(i):
+            # later submissions finish first; gather order must not care
+            time.sleep(0.02 * (4 - i))
+            return i
+        out = pool.run([lambda i=i: slow(i) for i in range(4)])
+        assert out == [0, 1, 2, 3]
+    finally:
+        pool.close()
+
+
+def test_run_drains_all_then_raises_first_error():
+    pool = HostPool(4)
+    ran = []
+    lock = threading.Lock()
+
+    def ok(i):
+        time.sleep(0.01)
+        with lock:
+            ran.append(i)
+        return i
+
+    def boom(tag):
+        raise RuntimeError(tag)
+
+    try:
+        with pytest.raises(RuntimeError, match="first"):
+            pool.run([lambda: ok(0), lambda: boom("first"),
+                      lambda: boom("second"), lambda: ok(3)])
+        # every thunk completed before the re-raise: no torn partition
+        assert sorted(ran) == [0, 3]
+    finally:
+        pool.close()
+
+
+def test_map_partitions_orders_by_key():
+    pool = HostPool(4)
+    try:
+        items = [7, 2, 9, 4, 1, 8]
+        seen = []
+        out = pool.map_partitions(
+            items,
+            key_fn=lambda x: x % 2,          # two partitions: odd/even
+            fn=lambda key, part: seen.append((key, list(part)))
+            or (key, sorted(part)))
+        # results in sorted-key order regardless of completion order
+        assert out == [(0, [2, 4, 8]), (1, [1, 7, 9])]
+        # partitions preserve item order within each group
+        assert dict(seen) == {0: [2, 4, 8], 1: [7, 9, 1]}
+    finally:
+        pool.close()
+
+
+def test_host_pool_from_env(monkeypatch):
+    monkeypatch.setenv("KUEUE_TPU_HOST_WORKERS", "3")
+    pool = host_pool_from_env()
+    assert pool.workers == 3 and pool.active
+    pool.close()
+    monkeypatch.setenv("KUEUE_TPU_HOST_WORKERS", "0")
+    assert host_pool_from_env().active is False
+
+
+# ---------------------------------------------------------------------------
+# WAL handshake: appender registration engages striping, seq-merged
+# replay stays total-ordered through pooled segment commits
+# ---------------------------------------------------------------------------
+
+def test_pool_attach_engages_wal_striping(tmp_path):
+    path = str(tmp_path / "wal.jsonl")
+    wal = ShardedCycleWAL(path, shards=4)
+    pool = HostPool(4)
+    try:
+        pool.attach_wal(wal)
+        assert wal.stats["wal_appenders"] == 4
+        for i in range(16):
+            wal.log({"op": "admit", "key": f"k{i}", "cycle": i})
+        used = {i for i, sh in enumerate(wal._shards) if sh.tail}
+        assert len(used) >= 2, "registered pool must engage striping"
+        before = POOL_STATS["host_pool_wal_commits"]
+        pool.commit_wal(wal)
+        assert POOL_STATS["host_pool_wal_commits"] == before + 1
+        assert wal.tail == []
+        pool.detach_wal(wal)
+        assert wal.stats["wal_appenders"] == 0
+        wal.log({"op": "admit", "key": "post", "cycle": 99})
+        assert wal._shards[0].tail, "detach must collapse to one segment"
+        wal.commit()
+        wal.close()
+        loaded = ShardedCycleWAL.load(path)
+        seqs = [op["seq"] for sh in loaded._shards
+                for b in (sh.batches + [sh.tail]) for op in b]
+        assert sorted(seqs) == list(range(len(seqs)))
+    finally:
+        pool.close()
+
+
+def test_inactive_pool_commit_falls_back_serial(tmp_path):
+    wal = ShardedCycleWAL(str(tmp_path / "wal.jsonl"), shards=2)
+    pool = HostPool(0)
+    pool.attach_wal(wal)        # no-op when inactive
+    assert wal.stats["wal_appenders"] == 0
+    wal.log({"op": "admit", "key": "a", "cycle": 0})
+    pool.commit_wal(wal)
+    assert wal.tail == []
+
+
+# ---------------------------------------------------------------------------
+# Twin-driver parity: pooled plane is decision-invisible
+# ---------------------------------------------------------------------------
+
+def _storm(d):
+    for c in range(2):
+        for q in range(2):
+            for i in range(10):
+                d.create_workload(mk(f"w-{c}-{q}-{i}", f"lq-{c}-{q}",
+                                     1500 if i % 3 else 2500,
+                                     prio=(i % 3) * 10,
+                                     t=float(10 * c + 3 * q + i)))
+
+
+def test_pooled_driver_decisions_identical(monkeypatch):
+    runs = {}
+    for workers in ("0", "4"):
+        monkeypatch.setenv("KUEUE_TPU_HOST_WORKERS", workers)
+        d, clock = build_mixed(two_flavors=True)
+        assert d.host_pool.workers == int(workers)
+        _storm(d)
+        stats = d.schedule_burst(
+            14, runtime=2,
+            on_cycle_start=lambda k: setattr(clock, "t", clock.t + 1.0))
+        runs[workers] = (
+            [(sorted(s.admitted), sorted(s.skipped),
+              sorted(s.inadmissible), sorted(s.preempted_targets))
+             for s in stats],
+            d.admitted_keys(),
+            d.stats["host_pool"]["host_pool_workers"])
+    assert runs["0"][0] == runs["4"][0], "pooled decisions diverged"
+    assert runs["0"][1] == runs["4"][1]
+    assert runs["4"][2] == 4 and runs["0"][2] == 0
+
+
+def test_pooled_wal_replay_parity(monkeypatch, tmp_path):
+    """Same storm, WAL attached both arms: the pooled arm's merged
+    seq-ordered tail must equal the serial arm's op-for-op."""
+    tails = {}
+    for workers in ("0", "4"):
+        monkeypatch.setenv("KUEUE_TPU_HOST_WORKERS", workers)
+        d, clock = build_mixed()
+        wal = ShardedCycleWAL(str(tmp_path / f"wal{workers}.jsonl"),
+                              shards=4)
+        d.attach_wal(wal)
+        _storm(d)
+        d.schedule_burst(
+            10, runtime=2,
+            on_cycle_start=lambda k: setattr(clock, "t", clock.t + 1.0))
+        wal.close()
+        loaded = ShardedCycleWAL.load(str(tmp_path / f"wal{workers}.jsonl"))
+        ops = sorted((op for sh in loaded._shards
+                      for b in (sh.batches + [sh.tail]) for op in b),
+                     key=lambda o: o["seq"])
+        tails[workers] = [{k: v for k, v in op.items() if k != "seq"}
+                          for op in ops]
+    assert tails["0"] == tails["4"], "pooled WAL stream diverged"

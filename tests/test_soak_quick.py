@@ -82,6 +82,9 @@ def test_scale_soak_quick(tmp_path):
     assert d["parity"]["decisions_identical_all"] is True
     # every r18 optimization off must still be bit-identical
     assert d["parity"]["decisions_identical_classic_all"] is True
+    # r19: the single-flag bulk-apply arm (only KUEUE_TPU_CYCLE_BULK_APPLY
+    # flipped) is the honest A/B denominator and may never change a decision
+    assert d["parity"]["decisions_identical_nobulk_all"] is True
     assert d["parity"]["max_res_ts_equal_all"] is True
     assert d["soak"]["completed"] is True
     assert d["soak"]["wal"]["wal_commits"] > 0
@@ -101,7 +104,22 @@ def test_scale_soak_quick(tmp_path):
     assert d["ceiling"]["rows_packed"] <= d["ceiling"]["rows_row_backed"]
     assert d["heap"]["microbench"]["order_parity"] is True
     assert d["wal_shard"]["replay_parity"] is True
-    assert len(d["residues"]["entries"]) >= 3
+    # r19: the single-appender sharded WAL auto-collapses to one hot
+    # segment; registered appenders re-engage striping
+    assert d["wal_shard"]["collapsed_segments"] == 1
+    assert d["wal_shard"]["striped_segments"] >= 2
+    # r19: head-only packing — the ceiling universe packs into a row
+    # *budget* charged only to preempting-forest rows
+    assert d["ceiling"]["active_cqs_pending"] >= d["ceiling"]["cqs"]
+    assert d["ceiling"]["rows_packed"] <= d["ceiling"]["row_budget"]
+    assert d["head_pack"]["budget_rows"] <= d["head_pack"]["grid_rows"]
+    assert d["head_pack"]["flag"] == "KUEUE_TPU_HEAD_PACK"
+    # r19: the pooled host apply/pack plane never changes a decision,
+    # and the pooled WAL-commit plane preserves total seq order
+    assert d["host_pool"]["decisions_identical"] is True
+    assert d["host_pool"]["cores_curve"]
+    assert all(p["seq_order_ok"] for p in d["host_pool"]["cores_curve"])
+    assert len(d["residues"]["entries"]) >= 4
     assert d["residues"]["walls"]
     assert _validate(out) == []
 

@@ -53,6 +53,8 @@ def _chaos_off():
 def test_sharded_wal_merges_tail_in_seq_order(tmp_path):
     path = str(tmp_path / "wal.jsonl")
     wal = ShardedCycleWAL(path, shards=4)
+    wal.register_appender("t0")
+    wal.register_appender("t1")   # >=2 appenders: striping engages
     keys = [f"ns/w{i}" for i in range(12)]
     for i, key in enumerate(keys):
         wal.log({"op": "requeue", "key": key, "count": i, "at": float(i)})
@@ -79,6 +81,8 @@ def test_sharded_wal_merges_tail_in_seq_order(tmp_path):
 
 def test_sharded_routing_is_stable_per_key():
     wal = ShardedCycleWAL(shards=4)
+    wal.register_appender("t0")
+    wal.register_appender("t1")
     for _ in range(3):
         wal.log({"op": "requeue", "key": "ns/a", "count": 0, "at": 0.0})
     homes = [i for i, sh in enumerate(wal._shards) if sh.tail]
@@ -98,6 +102,41 @@ def test_make_cycle_wal_honors_shard_env(monkeypatch, tmp_path):
     wal.close()
     # explicit arg wins over the flag
     assert isinstance(make_cycle_wal(shards=1), CycleWAL)
+
+
+def test_single_appender_collapses_to_one_segment(tmp_path):
+    """The r18 regression fix: with <=1 registered appender every op
+    routes to segment 0 (one hot stream, no stripe tax); registering a
+    second appender re-engages hash striping; the seq-merged tail and
+    the recovery read are identical through the transitions."""
+    path = str(tmp_path / "wal.jsonl")
+    wal = ShardedCycleWAL(path, shards=4)
+    keys = [f"ns/w{i}" for i in range(8)]
+    for i, key in enumerate(keys):                 # no appenders: collapse
+        wal.log({"op": "requeue", "key": key, "count": i, "at": float(i)})
+    assert len(wal._shards[0].tail) == 8
+    assert all(not sh.tail for sh in wal._shards[1:])
+    assert wal.stats["wal_appenders"] == 0
+
+    wal.register_appender("w0")
+    wal.register_appender("w1")                    # striping engages
+    for i, key in enumerate(keys):
+        wal.log({"op": "requeue", "key": key, "count": 100 + i,
+                 "at": float(i)})
+    assert sum(1 for sh in wal._shards if sh.tail) > 1
+    assert wal.stats["wal_appenders"] == 2
+
+    wal.unregister_appender("w1")                  # back to single writer
+    wal.log({"op": "deactivate", "key": "ns/w3"})
+    assert wal._shards[0].tail[-1]["key"] == "ns/w3"
+    # the merged tail never noticed any of it: strict seq order
+    assert [op["seq"] for op in wal.tail] == list(range(17))
+    wal.commit()
+    wal.close()
+    loaded = load_cycle_wal(path)
+    assert isinstance(loaded, ShardedCycleWAL)
+    assert loaded._seq == 17
+    assert loaded.tail == []
 
 
 # ---------------------------------------------------------------------------
@@ -263,6 +302,9 @@ def test_sharded_crash_between_segment_compactions(tmp_path):
     ctrl = str(tmp_path / "ctrl.jsonl")
     wal = ShardedCycleWAL(path, shards=3)
     ref = ShardedCycleWAL(ctrl, shards=3)
+    for w in (wal, ref):
+        w.register_appender("t0")
+        w.register_appender("t1")
     _fill(wal)
     _fill(ref)
 
