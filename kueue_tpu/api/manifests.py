@@ -217,12 +217,14 @@ def _decode_workload(doc: dict) -> Workload:
                 preferred=tr.get("preferred"),
                 unconstrained=bool(tr.get("unconstrained", False)))
                 if tr else None)))
+    meta = doc.get("metadata") or {}
     wl = Workload(
         name=name, namespace=namespace,
         queue_name=spec.get("queueName", ""),
         priority=spec.get("priority", 0),
         priority_class_name=spec.get("priorityClassName", ""),
         active=spec.get("active", True),
+        creation_time=float(meta.get("creationTimestamp") or 0.0),
         pod_sets=pod_sets,
         maximum_execution_time_seconds=spec.get(
             "maximumExecutionTimeSeconds"))
@@ -376,7 +378,12 @@ def _encode_workload(wl: Workload) -> dict:
              "lastTransitionTime": c.last_transition_time}
             for c in wl.conditions.values()]
     return {"apiVersion": "kueue.x-k8s.io/v1beta1", "kind": "Workload",
-            "metadata": {"name": wl.name, "namespace": wl.namespace},
+            "metadata": {"name": wl.name, "namespace": wl.namespace,
+                         # creation order must survive transport: a
+                         # worker rebuilt from journaled manifests has
+                         # no other source for the FIFO key
+                         **({"creationTimestamp": wl.creation_time}
+                            if wl.creation_time else {})},
             "spec": {"queueName": wl.queue_name, "priority": wl.priority,
                      "active": wl.active, "podSets": pod_sets},
             **({"status": status} if status else {})}

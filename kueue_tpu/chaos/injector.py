@@ -70,6 +70,14 @@ site                            effect at the call point
 ``svc.shutdown``                crash mid graceful drain: in-flight cycles
                                 finished but the final WAL/ingest-journal
                                 flush has not happened
+``dist.kill``                   SIGKILL the child process whose name matches
+                                ``payload`` (empty payload = any candidate)
+                                at the supervisor's next barrier consult —
+                                a real process death, not an exception
+``dist.proxy_fault``            inject a wire fault on the socket proxy's
+                                next connection: ``action`` picks the verb
+                                (reset/latency/truncate/blackhole),
+                                ``payload`` the seconds or bytes
 ==============================  =============================================
 
 ``KUEUE_TPU_CHAOS_SEED`` seeds the process-default injector (see

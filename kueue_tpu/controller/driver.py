@@ -1490,6 +1490,24 @@ class Driver:
                                       out.get("wal_shard"),
                                       out.get("head_pack"),
                                       out["host_pool"])
+        # distributed-run blocks, attached by the harness that owns the
+        # processes/clients (ProcFederation, dist_soak): summed
+        # HttpWorkerClient accounting and the supervisor's report
+        rpc_clients = getattr(self, "rpc_clients", None)
+        if rpc_clients:
+            agg_rpc: dict[str, int] = {}
+            for c in rpc_clients:
+                for k, v in c.stats.items():
+                    agg_rpc[k] = agg_rpc.get(k, 0) + int(v)
+            out["rpc"] = agg_rpc
+            self.metrics.rpc_sample(agg_rpc)
+        dist_stats = getattr(self, "dist_stats", None)
+        if dist_stats:
+            out["dist"] = dict(dist_stats)
+            self.metrics.dist_sample(
+                dist_stats.get("by_role", {}),
+                proxy_stats=dist_stats.get("proxy"),
+                shard_depths=dist_stats.get("shard_depths"))
         out["obs"] = self.obs.report()
         return out
 

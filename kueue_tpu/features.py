@@ -189,6 +189,29 @@ ENV_FLAGS: dict[str, EnvFlag] = {f.name: f for f in (
             "Worker threads for the parallel host apply/pack plane "
             "(cache rebuild fan-out, dirty-CQ pack walk, requeue "
             "wakeups, WAL shard appends); 0 or 1 = serial."),
+    EnvFlag("KUEUE_TPU_DIST_SEED", "2003", "int",
+            "Seed for the distributed soak: process-kill schedule and "
+            "the socket-fault proxy's per-connection rolls."),
+    EnvFlag("KUEUE_TPU_DIST_SHARDS", "2", "int",
+            "Front-end shard processes in the distributed soak (the "
+            "LocalQueue-sharded admission services)."),
+    EnvFlag("KUEUE_TPU_DIST_SUBMITTERS", "2", "int",
+            "Submitter processes hammering the serving API in the "
+            "distributed soak."),
+    EnvFlag("KUEUE_TPU_DIST_WORKERS", "2", "int",
+            "Federation worker processes in the distributed soak."),
+    EnvFlag("KUEUE_TPU_DIST_PROXY_RESET", "0.0", "str",
+            "Socket-fault proxy: per-connection probability of a hard "
+            "RST before the request reaches upstream."),
+    EnvFlag("KUEUE_TPU_DIST_PROXY_LATENCY_S", "0.0", "str",
+            "Socket-fault proxy: seconds of added latency before "
+            "dialing upstream (0 disables the latency fault)."),
+    EnvFlag("KUEUE_TPU_DIST_PROXY_TRUNCATE", "0.0", "str",
+            "Socket-fault proxy: per-connection probability of "
+            "truncating the response mid-body and resetting."),
+    EnvFlag("KUEUE_TPU_DIST_PROXY_BLACKHOLE", "0.0", "str",
+            "Socket-fault proxy: per-connection probability of "
+            "swallowing the request and never answering."),
 )}
 
 

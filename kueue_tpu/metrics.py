@@ -403,6 +403,50 @@ class Registry:
         self.set_gauge("kueue_svc_retry_after_seconds", (),
                        float(retry_after_s))
 
+    def dist_sample(self, by_role: dict, proxy_stats=None,
+                    shard_depths=None) -> None:
+        """Distributed-run telemetry: supervisor per-role lifecycle
+        counts, socket-fault proxy totals, per-shard ingest depths."""
+        for role, counts in by_role.items():
+            self.set_gauge("kueue_dist_process_spawns_total", (role,),
+                           float(counts.get("spawns", 0)))
+            self.set_gauge("kueue_dist_process_kills_total", (role,),
+                           float(counts.get("kills", 0)))
+            self.set_gauge("kueue_dist_process_restarts_total", (role,),
+                           float(counts.get("restarts", 0)))
+        if proxy_stats:
+            self.set_gauge("kueue_dist_proxy_connections_total", (),
+                           float(proxy_stats.get("connections", 0)))
+            for kind, stat in (("reset", "resets"),
+                               ("latency", "latencies"),
+                               ("truncate", "truncations"),
+                               ("blackhole", "blackholes")):
+                self.set_gauge("kueue_dist_proxy_faults_total", (kind,),
+                               float(proxy_stats.get(stat, 0)))
+        for shard, depth in (shard_depths or {}).items():
+            self.set_gauge("kueue_dist_shard_ingest_depth",
+                           (str(shard),), float(depth))
+
+    def rpc_sample(self, stats: dict) -> None:
+        """HTTP worker-client accounting (one client's ``.stats`` or a
+        summed aggregate): requests, retries by transport cause,
+        exhausted deadlines, noticed watch-epoch changes."""
+        self.set_gauge("kueue_rpc_requests_total", (),
+                       float(stats.get("requests", 0)))
+        refused = stats.get("refused_retries", 0)
+        midbody = stats.get("midbody_retries", 0)
+        other = max(0, stats.get("retries", 0) - refused - midbody)
+        self.set_gauge("kueue_rpc_retries_total", ("refused",),
+                       float(refused))
+        self.set_gauge("kueue_rpc_retries_total", ("mid_body",),
+                       float(midbody))
+        self.set_gauge("kueue_rpc_retries_total", ("other",),
+                       float(other))
+        self.set_gauge("kueue_rpc_deadline_exhausted_total", (),
+                       float(stats.get("deadline_exhausted", 0)))
+        self.set_gauge("kueue_rpc_epoch_resyncs_total", (),
+                       float(stats.get("epoch_resyncs", 0)))
+
     # -- exposition --
 
     def render(self) -> str:
@@ -657,6 +701,30 @@ _SERIES_DEFS = [
      "EWMA of the submission arrival rate, events/s."),
     ("kueue_svc_retry_after_seconds", "gauge", (),
      "Current retry-after hint handed to rejected submitters."),
+    # distributed control plane (dist/)
+    ("kueue_dist_process_spawns_total", "gauge", ("role",),
+     "Child processes spawned by the supervisor, by role."),
+    ("kueue_dist_process_kills_total", "gauge", ("role",),
+     "Child processes SIGKILLed by the supervisor, by role."),
+    ("kueue_dist_process_restarts_total", "gauge", ("role",),
+     "Killed child processes respawned by the supervisor, by role."),
+    ("kueue_dist_proxy_connections_total", "gauge", (),
+     "Connections accepted by the socket-fault proxy."),
+    ("kueue_dist_proxy_faults_total", "gauge", ("kind",),
+     "Wire faults injected by the socket-fault proxy "
+     "(reset/latency/truncate/blackhole)."),
+    ("kueue_dist_shard_ingest_depth", "gauge", ("shard",),
+     "Pending submissions per front-end shard process."),
+    # remote-transport client accounting (remote.py HttpWorkerClient)
+    ("kueue_rpc_requests_total", "gauge", (),
+     "HTTP worker-client requests issued, attempts included."),
+    ("kueue_rpc_retries_total", "gauge", ("cause",),
+     "HTTP worker-client in-place retries by transport cause "
+     "(refused/mid_body/other)."),
+    ("kueue_rpc_deadline_exhausted_total", "gauge", (),
+     "Requests whose retry budget ran out (surfaced ConnectionLost)."),
+    ("kueue_rpc_epoch_resyncs_total", "gauge", (),
+     "Watch-epoch changes noticed by clients (worker restarts)."),
 ]
 
 SERIES: dict[str, Series] = {

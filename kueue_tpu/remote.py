@@ -34,7 +34,36 @@ from .chaos import injector as _chaos
 
 
 class ConnectionLost(Exception):
-    """A transport failure: the cluster should be marked lost."""
+    """A transport failure: the cluster should be marked lost.
+
+    ``kind`` classifies the failure for the retry policy over real
+    sockets:
+
+    - ``refused``: connect() was rejected — nothing reached the worker,
+      so the request is trivially safe to retry (the worker is
+      restarting behind its supervisor);
+    - ``mid_body``: the connection died after the request went out
+      (reset, broken pipe, truncated response) — the worker may have
+      applied a mutation before the reply was lost, so a mutating retry
+      first probes the watch epoch for a restart;
+    - ``timeout`` / ``http`` / ``transport``: the undifferentiated rest.
+    """
+
+    def __init__(self, msg: str, kind: str = "transport"):
+        super().__init__(msg)
+        self.kind = kind
+
+
+def state_digest(driver) -> str:
+    """Digest of every workload's full durable status (timestamps
+    included), shared by both ends of the distributed parity checks: a
+    worker process answers ``/admin/digest`` with it and the
+    single-process control computes it locally, so bit-identical state
+    compares as equal strings with no JSON round-trip in between."""
+    import hashlib
+    from .federation.sim import full_state
+    blob = repr(sorted(full_state(driver).items())).encode()
+    return hashlib.sha256(blob).hexdigest()[:16]
 
 
 class LocalWorkerClient:
@@ -325,7 +354,29 @@ class HttpWorkerClient:
         self.backoff_max = backoff_max
         self.deadline_s = (float(env_int("KUEUE_TPU_REMOTE_DEADLINE_S"))
                            if deadline_s is None else deadline_s)
-        self.stats = {"requests": 0, "retries": 0, "deadline_exhausted": 0}
+        self.stats = {"requests": 0, "retries": 0, "deadline_exhausted": 0,
+                      "refused_retries": 0, "midbody_retries": 0,
+                      "epoch_resyncs": 0}
+        # last watch epoch seen (from /healthz or the watch stream);
+        # the mid-body retry path probes against it to detect a worker
+        # restart hiding behind a half-delivered response
+        self._epoch: Optional[str] = None
+
+    def _note_epoch(self, epoch) -> None:
+        if not epoch:
+            return
+        if self._epoch is not None and epoch != self._epoch:
+            self.stats["epoch_resyncs"] += 1
+        self._epoch = epoch
+
+    def _probe_epoch(self):
+        """One unretried health probe for the current watch epoch;
+        None when the worker is (still) unreachable."""
+        try:
+            out = self._request_once("GET", "/healthz")
+        except ConnectionLost:
+            return None
+        return (out or {}).get("epoch")
 
     @staticmethod
     def _jitter(path: str, attempt: int) -> float:
@@ -334,7 +385,7 @@ class HttpWorkerClient:
 
     def _request(self, method: str, path: str, body: Optional[dict] = None,
                  timeout_override: Optional[float] = None,
-                 retries: Optional[int] = None):
+                 retries: Optional[int] = None, mutating: bool = False):
         import time as _time
         budget = self.retries if retries is None else retries
         deadline = _time.monotonic() + self.deadline_s
@@ -343,7 +394,7 @@ class HttpWorkerClient:
             try:
                 return self._request_once(method, path, body,
                                           timeout_override)
-            except ConnectionLost:
+            except ConnectionLost as e:
                 if attempt >= budget:
                     raise
                 backoff = min(self.backoff_base * (2 ** attempt),
@@ -353,6 +404,21 @@ class HttpWorkerClient:
                     self.stats["deadline_exhausted"] += 1
                     raise
                 self.stats["retries"] += 1
+                if e.kind == "refused":
+                    # nothing reached the worker: a plain retry within
+                    # the deadline rides out a restarting process
+                    self.stats["refused_retries"] += 1
+                elif e.kind == "mid_body":
+                    self.stats["midbody_retries"] += 1
+                    if mutating:
+                        # the worker may have applied the mutation and
+                        # died before answering; if it restarted, the
+                        # epoch moved — noting it here bumps the resync
+                        # counter so the watch replays from zero.  The
+                        # retry itself stays safe either way: the worker
+                        # API is idempotent (create keyed, delete/finish
+                        # no-ops when already applied)
+                        self._note_epoch(self._probe_epoch())
                 _time.sleep(backoff)
                 attempt += 1
 
@@ -378,27 +444,54 @@ class HttpWorkerClient:
                 # it lost (multikueuecluster.go only reconnects on
                 # transport failures)
                 return None
-            raise ConnectionLost(f"{method} {path}: HTTP {e.code}") from e
-        except OSError as e:               # refused / reset / timeout
-            raise ConnectionLost(f"{method} {path}: {e}") from e
+            raise ConnectionLost(f"{method} {path}: HTTP {e.code}",
+                                 kind="http") from e
+        except ConnectionRefusedError as e:
+            raise ConnectionLost(f"{method} {path}: {e}",
+                                 kind="refused") from e
+        except (ConnectionResetError, BrokenPipeError) as e:
+            raise ConnectionLost(f"{method} {path}: {e}",
+                                 kind="mid_body") from e
+        except OSError as e:
+            # urllib wraps connect-phase failures in URLError(reason);
+            # unwrap so refused-vs-reset keeps its meaning there too
+            import socket
+            reason = getattr(e, "reason", None)
+            if isinstance(reason, ConnectionRefusedError):
+                kind = "refused"
+            elif isinstance(reason, (ConnectionResetError,
+                                     BrokenPipeError)):
+                kind = "mid_body"
+            elif isinstance(e, socket.timeout) or isinstance(
+                    reason, socket.timeout):
+                kind = "timeout"
+            else:
+                kind = "transport"
+            raise ConnectionLost(f"{method} {path}: {e}", kind=kind) from e
         except Exception as e:
             # http.client.IncompleteRead/BadStatusLine etc.: a worker
-            # dying mid-response is a transport failure, not a crash
+            # dying mid-response is a transport failure, not a crash —
+            # and since the request went out, a possible partial apply
             import http.client
             if isinstance(e, http.client.HTTPException):
-                raise ConnectionLost(f"{method} {path}: {e}") from e
+                raise ConnectionLost(f"{method} {path}: {e}",
+                                     kind="mid_body") from e
             raise
 
     def healthy(self) -> bool:
         # no retries: this is the half-open probe — the controller's
         # reconnect backoff owns the retry cadence
         try:
-            return self._request("GET", "/healthz", retries=0) is not None
+            out = self._request("GET", "/healthz", retries=0)
         except ConnectionLost:
             return False
+        if isinstance(out, dict):
+            self._note_epoch(out.get("epoch"))
+        return out is not None
 
     def create_workload(self, wl: Workload) -> None:
-        self._request("POST", "/apis/workloads", m.to_manifest(wl))
+        self._request("POST", "/apis/workloads", m.to_manifest(wl),
+                      mutating=True)
 
     def get_workload(self, key: str) -> Optional[Workload]:
         ns, _, name = key.partition("/")
@@ -407,7 +500,8 @@ class HttpWorkerClient:
 
     def delete_workload(self, key: str) -> None:
         ns, _, name = key.partition("/")
-        self._request("DELETE", f"/apis/workloads/{ns}/{name}")
+        self._request("DELETE", f"/apis/workloads/{ns}/{name}",
+                      mutating=True)
 
     def list_workload_keys(self) -> list[str]:
         out = self._request("GET", "/apis/workloads")
@@ -426,7 +520,28 @@ class HttpWorkerClient:
         """Test/executor hook: flip the remote workload finished."""
         ns, _, name = key.partition("/")
         self._request("POST", f"/apis/workloads/{ns}/{name}/finish",
-                      {"message": message})
+                      {"message": message}, mutating=True)
+
+    # -- lockstep-harness admin endpoints (WorkerServer admin=True) --
+
+    def set_clock(self, t: float) -> None:
+        """Pin the worker's virtual clock (idempotent: same t, same
+        result — safe under the mutating retry path)."""
+        self._request("POST", "/admin/clock", {"t": t}, mutating=True)
+
+    def admin_step(self) -> Optional[dict]:
+        """One scheduling cycle on the worker.  Safe to retry within a
+        lockstep barrier: re-running with unchanged state admits
+        nothing further."""
+        return self._request("POST", "/admin/step", {}, mutating=True)
+
+    def admin_status(self) -> dict:
+        out = self._request("GET", "/admin/status") or {}
+        return out.get("status", {})
+
+    def admin_digest(self) -> Optional[str]:
+        out = self._request("GET", "/admin/digest") or {}
+        return out.get("digest")
 
     def watch_events(self, since: int, timeout: float = 20.0):
         """Long-poll the worker's event stream from resume token
@@ -437,8 +552,53 @@ class HttpWorkerClient:
             timeout_override=timeout + self.timeout, retries=0)
         if out is None:
             return [], since, None
+        self._note_epoch(out.get("epoch"))
         return ([tuple(e) for e in out.get("events", [])],
                 int(out.get("next", since)), out.get("epoch"))
+
+
+class DrainingHTTPServer(ThreadingHTTPServer):
+    """ThreadingHTTPServer hardened for restart-under-test.
+
+    - ``allow_reuse_address``: a supervisor restarting a killed child
+      on the *same* bound port must not trip TIME_WAIT, so client
+      base_urls survive the restart (bound-port handoff);
+    - in-flight handler census: ``finish_request`` is bracketed by a
+      counter so :meth:`drain` can wait for handlers already running to
+      complete before the listening socket closes — graceful shutdown
+      finishes in-flight work instead of resetting it;
+    - ``draining`` flips the ``/readyz`` probe to 503 (and breaks the
+      watch long-poll) so pollers stop routing new work mid-drain.
+    """
+
+    allow_reuse_address = True
+    daemon_threads = True
+
+    def __init__(self, *args, **kw):
+        super().__init__(*args, **kw)
+        self._inflight = 0
+        self._inflight_lock = threading.Lock()
+        self._idle = threading.Event()
+        self._idle.set()
+        self.draining = False
+
+    def finish_request(self, request, client_address):
+        with self._inflight_lock:
+            self._inflight += 1
+            self._idle.clear()
+        try:
+            super().finish_request(request, client_address)
+        finally:
+            with self._inflight_lock:
+                self._inflight -= 1
+                if self._inflight == 0:
+                    self._idle.set()
+
+    def drain(self, timeout: float = 5.0) -> bool:
+        """Stop advertising readiness and wait for in-flight handlers;
+        True when the server went idle inside the timeout."""
+        self.draining = True
+        return self._idle.wait(timeout)
 
 
 class _Handler(BaseHTTPRequestHandler):
@@ -465,7 +625,22 @@ class _Handler(BaseHTTPRequestHandler):
 
     def do_GET(self):
         if self.path == "/healthz":
-            self._send(200, {"ok": True})
+            # liveness + the watch epoch, so one probe tells a client
+            # both "alive" and "did it restart since I last looked"
+            self._send(200, {
+                "ok": True,
+                "epoch": getattr(self.server, "epoch", None),
+                "ready": not getattr(self.server, "draining", False)})
+            return
+        if self.path == "/readyz":
+            # readiness: the supervisor polls this instead of sleeping
+            if getattr(self.server, "draining", False):
+                self._send(503, {"ready": False})
+            else:
+                self._send(200, {"ready": True})
+            return
+        if self.path.startswith("/admin/"):
+            self._admin_get()
             return
         if self.path.startswith("/apis/watch"):
             # long-poll watch stream (reference multikueuecluster.go:187
@@ -480,7 +655,8 @@ class _Handler(BaseHTTPRequestHandler):
             import time as _time
             deadline = _time.monotonic() + timeout
             events = self.driver.events
-            while len(events) <= since and _time.monotonic() < deadline:
+            while (len(events) <= since and _time.monotonic() < deadline
+                   and not getattr(self.server, "draining", False)):
                 _time.sleep(0.02)
             batch = [list(e) for e in events[since:]]
             self._send(200, {"events": batch,
@@ -503,9 +679,53 @@ class _Handler(BaseHTTPRequestHandler):
             return
         self._send(404)
 
+    def _admin_get(self):
+        """Lockstep-harness read endpoints (``admin=True`` servers only):
+        the distributed soak's parent process reads worker state through
+        these instead of reaching into another process's memory."""
+        if not getattr(self.server, "admin", False):
+            self._send(404)
+            return
+        if self.path == "/admin/status":
+            self._send(200, {"status": {
+                k: [wl.has_quota_reservation, wl.is_finished]
+                for k, wl in list(self.driver.workloads.items())}})
+            return
+        if self.path == "/admin/digest":
+            self._send(200, {"digest": state_digest(self.driver),
+                             "n": len(self.driver.workloads)})
+            return
+        self._send(404)
+
+    def _admin_post(self, body):
+        """Lockstep-harness mutation endpoints: the parent advances a
+        child's virtual clock and runs its admission cycles at step
+        barriers, which is what keeps N processes bit-deterministic."""
+        if not getattr(self.server, "admin", False):
+            self._send(404)
+            return
+        if self.path == "/admin/step":
+            with self.server.step_lock:
+                stats = self.driver.schedule_once()
+            self._send(200, {"admitted": sorted(stats.admitted)})
+            return
+        if self.path == "/admin/clock":
+            clk = getattr(self.server, "clock", None)
+            if clk is None:
+                self._send(404)
+                return
+            with self.server.step_lock:
+                clk.t = float(body["t"])
+            self._send(200, {"t": clk.t})
+            return
+        self._send(404)
+
     def do_POST(self):
         length = int(self.headers.get("Content-Length") or 0)
         body = json.loads(self.rfile.read(length)) if length else {}
+        if self.path.startswith("/admin/"):
+            self._admin_post(body)
+            return
         if self.path.endswith("/finish"):
             key = self._wl_key()
             if key is None or key not in self.driver.workloads:
@@ -523,6 +743,11 @@ class _Handler(BaseHTTPRequestHandler):
                 return
             if wl.key not in self.driver.workloads:
                 self.driver.create_workload(wl)
+                jr = getattr(self.server, "journal", None)
+                if jr is not None:
+                    # manifest durable before the ack: a SIGKILLed
+                    # worker rebuilds its initial payloads from here
+                    jr.put(wl.key, body)
             self._send(201, {"ok": True})
             return
         self._send(404)
@@ -533,20 +758,39 @@ class _Handler(BaseHTTPRequestHandler):
             self._send(404)
             return
         self.driver.delete_workload(key)
+        jr = getattr(self.server, "journal", None)
+        if jr is not None:
+            jr.delete(key)
         self._send(200, {"ok": True})
 
 
 class WorkerServer:
-    """The worker-side HTTP API, served next to the admission daemon."""
+    """The worker-side HTTP API, served next to the admission daemon.
 
-    def __init__(self, driver, port: int = 0, host: str = "127.0.0.1"):
+    ``journal`` (a ``ManifestJournal``) makes creates/deletes durable
+    before their ack.  ``admin=True`` exposes the lockstep harness
+    endpoints (``/admin/step``, ``/admin/clock``, ``/admin/status``,
+    ``/admin/digest``) the distributed soak drives child processes
+    with; ``clock`` is the mutable virtual clock ``/admin/clock``
+    sets.  ``epoch`` pins the watch-log epoch (tests); by default a
+    restarted process serves a fresh one, which is what tells managers
+    their resume tokens died with the old process."""
+
+    def __init__(self, driver, port: int = 0, host: str = "127.0.0.1",
+                 journal=None, admin: bool = False, clock=None,
+                 epoch: Optional[str] = None):
         import uuid
         handler = type("BoundHandler", (_Handler,), {"driver": driver})
-        self.httpd = ThreadingHTTPServer((host, port), handler)
+        self.httpd = DrainingHTTPServer((host, port), handler)
         # watch-log epoch: a restarted worker process serves a fresh
         # (shorter) event log, so resume tokens from the old epoch must
         # trigger a replay-from-zero + resync instead of silent skips
-        self.httpd.epoch = uuid.uuid4().hex
+        self.httpd.epoch = epoch or uuid.uuid4().hex
+        self.httpd.journal = journal
+        self.httpd.admin = admin
+        self.httpd.clock = clock
+        self.httpd.step_lock = threading.Lock()
+        self.driver = driver
         self.port = self.httpd.server_address[1]
         self._thread: Optional[threading.Thread] = None
 
@@ -555,7 +799,11 @@ class WorkerServer:
                                         daemon=True)
         self._thread.start()
 
-    def stop(self) -> None:
+    def stop(self, graceful: bool = True) -> None:
+        if graceful:
+            # finish in-flight handlers before the socket closes; the
+            # draining flag also breaks pending watch long-polls
+            self.httpd.drain(timeout=5.0)
         self.httpd.shutdown()
         self.httpd.server_close()
         if self._thread is not None:

@@ -394,6 +394,21 @@ class CycleWAL:
         return list(self._open or ())
 
     @classmethod
+    def resume(cls, path: str) -> "CycleWAL":
+        """Crash recovery for a process that keeps running: rebuild
+        batches and tail from disk *and* reopen the file for appending.
+
+        The loaded ``_open`` tail is carried over, so after the caller
+        replays it (``replay_tail``) a plain ``commit()`` writes only
+        the commit marker — the tail's ops are already on disk — and
+        the journal continues exactly where the killed process left it.
+        ``commit_every`` falls back to the registry default, as in
+        ``__init__``."""
+        wal = cls.load(path)
+        wal._fh = open(path, "a", encoding="utf-8")
+        return wal
+
+    @classmethod
     def load(cls, path: str) -> "CycleWAL":
         """Rebuild a WAL from its JSON-lines file (the recovery read
         path).  The returned WAL is read-only-ish: it has no file handle
@@ -434,6 +449,28 @@ class CycleWAL:
         for op in self.tail:
             if replay_op(store, op):
                 n += 1
+        return n
+
+    def replay_history(self, store: dict) -> int:
+        """Roll *committed* batches forward over ``store``, in order.
+
+        The normal recovery path never needs this — committed batches
+        are by definition fully applied to the durable store.  The
+        distributed children invert that: their durable store is the
+        ingest/manifest journal of *initial* payloads, and the WAL is
+        the only record of every decision since, so recovery is
+        initial-state + full history + tail.  Refuses a compacted
+        journal (the folded ops are gone); dist children therefore run
+        with compaction off."""
+        if self.folded_batches:
+            raise RuntimeError(
+                f"replay_history on a compacted WAL ({self.folded_batches} "
+                f"batches folded away): full history is gone")
+        n = 0
+        for batch in self.batches:
+            for op in batch:
+                if replay_op(store, op):
+                    n += 1
         return n
 
 
@@ -874,3 +911,69 @@ class IngestJournal:
         j = cls.load(path)
         j._fh = open(path, "a", encoding="utf-8")
         return j
+
+
+# -- manifest journal -------------------------------------------------------
+
+class ManifestJournal:
+    """Durable store of workload *manifests* — the IngestJournal's
+    federation-worker sibling.
+
+    A federation worker process receives workloads through the remote
+    CRUD API, not a serving front-end, so there is no accept record to
+    recover the initial payload from.  This journal records each
+    created workload's manifest (the same dict ``api.manifests``
+    round-trips) before the create is acked, and a tombstone on delete;
+    together with the worker's CycleWAL (full-history replay, see
+    :meth:`CycleWAL.replay_history`) a SIGKILLed worker rebuilds its
+    exact pre-kill state.  Two record kinds, one JSON object per line::
+
+        {"mf": "put", "key": "ns/name", "doc": {...}}
+        {"mf": "del", "key": "ns/name"}
+
+    Every record flushes immediately — like ingest records, each one
+    backs an ack already returned to the manager."""
+
+    def __init__(self, path: Optional[str] = None):
+        self.path = path
+        self._fh = open(path, "a", encoding="utf-8") if path else None
+        self.stats = {"mf_puts": 0, "mf_dels": 0}
+
+    def put(self, key: str, doc: dict) -> None:
+        self._emit({"mf": "put", "key": key, "doc": doc})
+        self.stats["mf_puts"] += 1
+
+    def delete(self, key: str) -> None:
+        self._emit({"mf": "del", "key": key})
+        self.stats["mf_dels"] += 1
+
+    def _emit(self, rec: dict) -> None:
+        if self._fh is None:
+            return
+        self._fh.write(json.dumps(rec, sort_keys=True) + "\n")
+        self._fh.flush()
+
+    def close(self) -> None:
+        if self._fh is not None:
+            self._fh.flush()
+            self._fh.close()
+            self._fh = None
+
+    @classmethod
+    def load(cls, path: str) -> dict:
+        """Fold the journal into ``{key: manifest}`` with tombstones
+        applied — the worker's surviving initial-state store."""
+        docs: dict[str, dict] = {}
+        if not os.path.exists(path):
+            return docs
+        with open(path, "r", encoding="utf-8") as fh:
+            for line in fh:
+                line = line.strip()
+                if not line:
+                    continue
+                rec = json.loads(line)
+                if rec.get("mf") == "put":
+                    docs[rec["key"]] = rec["doc"]
+                elif rec.get("mf") == "del":
+                    docs.pop(rec["key"], None)
+        return docs

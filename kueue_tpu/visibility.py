@@ -166,20 +166,25 @@ class VisibilityServer:
     (reference visibility/server.go:62 + kueueviz backend)."""
 
     def __init__(self, driver, host: str = "127.0.0.1", port: int = 0,
-                 admission=None):
+                 admission=None, admin: bool = False):
         self.service = VisibilityService(driver)
         self.admission = admission   # serving.AdmissionService, optional
+        self.admin = admin           # lockstep-harness admin endpoints
         self.host = host
         self.port = port
         self._httpd = None
         self._thread = None
 
     def start(self) -> int:
-        from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+        from http.server import BaseHTTPRequestHandler
         from urllib.parse import parse_qs, urlsplit
+
+        from .remote import DrainingHTTPServer, state_digest
 
         service = self.service
         admission = self.admission
+        admin_enabled = self.admin
+        step_lock = threading.Lock()
 
         class Handler(BaseHTTPRequestHandler):
             def log_message(self, *a):  # quiet
@@ -196,9 +201,29 @@ class VisibilityServer:
                 self.wfile.write(payload)
 
             def do_POST(self):
+                path = self.path.split("?")[0]
+                if path.startswith("/admin/"):
+                    # lockstep-harness mutations: the distributed soak's
+                    # parent drives each shard's service steps through
+                    # these barriers instead of a wall-clock serve loop
+                    if not admin_enabled or admission is None:
+                        self.send_response(404)
+                        self.end_headers()
+                        return
+                    if path == "/admin/step":
+                        with step_lock:
+                            self._send_json(admission.step())
+                    elif path == "/admin/drain":
+                        with step_lock:
+                            clean = admission.drain()
+                        self._send_json({"clean": clean})
+                    else:
+                        self.send_response(404)
+                        self.end_headers()
+                    return
                 # /apis/serving/v1/submit — the admission API: accept /
                 # reject-with-retry-after / duplicate, all explicit
-                if self.path.split("?")[0] != "/apis/serving/v1/submit" \
+                if path != "/apis/serving/v1/submit" \
                         or admission is None:
                     self.send_response(404)
                     self.end_headers()
@@ -234,6 +259,31 @@ class VisibilityServer:
                     self._send_json(body)
 
             def do_GET(self):
+                if self.path.split("?")[0] == "/healthz":
+                    self._send_json({
+                        "ok": True,
+                        "ready": not getattr(self.server, "draining",
+                                             False)})
+                    return
+                if self.path.split("?")[0] == "/readyz":
+                    # readiness the supervisor polls instead of sleeping
+                    if getattr(self.server, "draining", False):
+                        self._send_json({"ready": False}, code=503)
+                    else:
+                        self._send_json({"ready": True})
+                    return
+                if self.path.split("?")[0] == "/admin/digest":
+                    if not admin_enabled:
+                        self.send_response(404)
+                        self.end_headers()
+                        return
+                    with step_lock:
+                        body = {"digest": state_digest(service.driver),
+                                "n": len(service.driver.workloads)}
+                        if admission is not None:
+                            body["cycle"] = admission.cycle_index
+                    self._send_json(body)
+                    return
                 if self.path.split("?")[0] in ("/", "/index.html"):
                     # kueueviz-equivalent dashboard (reference
                     # cmd/kueueviz): live CQ table fed by the visibility
@@ -344,15 +394,18 @@ class VisibilityServer:
                 self.end_headers()
                 self.wfile.write(payload)
 
-        self._httpd = ThreadingHTTPServer((self.host, self.port), Handler)
+        self._httpd = DrainingHTTPServer((self.host, self.port), Handler)
         self.port = self._httpd.server_address[1]
         self._thread = threading.Thread(target=self._httpd.serve_forever,
                                         daemon=True)
         self._thread.start()
         return self.port
 
-    def stop(self) -> None:
+    def stop(self, graceful: bool = True) -> None:
         if self._httpd is not None:
+            if graceful:
+                # finish in-flight submits before the socket closes
+                self._httpd.drain(timeout=5.0)
             self._httpd.shutdown()
             self._httpd.server_close()
             self._httpd = None

@@ -1,0 +1,706 @@
+#!/usr/bin/env python
+"""Distributed control-plane soak: real processes, real sockets, kills.
+
+The control plane splits across OS processes — N submitter processes
+hammer front-end shard processes through ``POST
+/apis/serving/v1/submit``, federation workers run as ``WorkerServer``
+processes behind ``HttpWorkerClient`` (optionally through a
+``SocketFaultProxy``) — and a seeded :class:`ProcessSupervisor`
+SIGKILLs them on a deterministic ``dist.kill`` schedule.
+
+Arms, one artifact (DIST):
+
+- **saturation** — wall-clock throughput search: every submitter
+  process blasts uniquely-named submissions as fast as the wire
+  allows, the shards drain the backlog through real ``/admin/step``
+  cycles, and the round size doubles until the measured admissions/s
+  stops improving; the ceiling is the best sustained rate.
+- **kills** — four process-death arms, each recovering with zero lost
+  and zero duplicated admissions and decisions bit-identical to a
+  single-process control fed the same deterministic schedule:
+  ``submitter`` (killed mid-run; replays its schedule from zero and
+  every replay dedupes), ``front_end_shard`` (killed at a barrier;
+  rebuilt from its IngestJournal + CycleWAL on the same port),
+  ``service_mid_cycle`` (dies at an armed ``svc.cycle`` crashpoint
+  inside ``/admin/step``, exit 17, no cleanup), and
+  ``federation_worker`` (SIGKILLed behind a fault-injecting proxy;
+  journal rebuild + fresh-watch-epoch resync over the wire keep every
+  digest bit-identical to the in-process FederationSim control).
+- **socket_faults** — the proxy's wire faults against the client's
+  retry classification: connect-refused retries within the deadline,
+  truncated responses count as mid-body and probe the watch epoch,
+  blackholes end at the socket timeout.
+
+Artifact: DIST_r20.json (see README "Distributed control plane").
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import tempfile
+import time
+from collections import Counter
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from kueue_tpu.chaos import injector as chaos
+from kueue_tpu.chaos.injector import ChaosInjector
+from kueue_tpu.dist.proxy import FaultPlan, SocketFaultProxy
+from kueue_tpu.dist.serving import ShardClient, build_shard_service, step_payloads
+from kueue_tpu.dist.supervisor import ProcessSupervisor, child_argv
+from kueue_tpu.features import env_int
+
+
+def log(msg: str) -> None:
+    print(msg, file=sys.stderr, flush=True)
+
+
+# ---------------------------------------------------------------------------
+# Harness pieces
+# ---------------------------------------------------------------------------
+
+def _shard_argv(tmp, shard_id, n_cqs, recover=False, resume_cycle=0,
+                port=0, crash_site="", crash_at=0):
+    pf = f"{tmp}/shard{shard_id}.port"
+    kw = dict(shard_id=shard_id, n_cqs=n_cqs, state_dir=str(tmp),
+              port_file=pf, recover=recover, resume_cycle=resume_cycle,
+              port=port)
+    if crash_site:
+        kw.update(crash_site=crash_site, crash_at=crash_at)
+    return child_argv("shard", **kw), pf
+
+
+def _spawn_shards(sup, tmp, n_shards, n_cqs):
+    shards = []
+    for s in range(n_shards):
+        argv, pf = _shard_argv(tmp, s, n_cqs)
+        shards.append(sup.spawn(f"shard{s}", "shard", argv, port_file=pf))
+    for mp in shards:
+        sup.wait_ready(mp)
+    return shards
+
+
+def _spawn_submitter(sup, j, n_sub, per_step, n_cqs, ports):
+    mp = sup.spawn(
+        f"sub{j}", "submitter",
+        child_argv("submitter", submitter_id=j, n_submitters=n_sub,
+                   per_step=per_step, n_cqs=n_cqs,
+                   shard_ports=",".join(map(str, ports))),
+        pipe_stdio=True)
+    assert mp.proc.stdout.readline().strip() == "ready"
+    return mp
+
+
+def _spawn_submitters(sup, tmp, n_sub, per_step, n_cqs, ports):
+    return [_spawn_submitter(sup, j, n_sub, per_step, n_cqs, ports)
+            for j in range(n_sub)]
+
+
+def _cmd(mp, line: str) -> str:
+    mp.proc.stdin.write(line + "\n")
+    mp.proc.stdin.flush()
+    return mp.proc.stdout.readline().strip()
+
+
+def _cmd_all(subs, line: str) -> list[str]:
+    for mp in subs:
+        mp.proc.stdin.write(line + "\n")
+        mp.proc.stdin.flush()
+    return [mp.proc.stdout.readline().strip() for mp in subs]
+
+
+def _control(tmp, n_cqs):
+    os.makedirs(f"{tmp}/ctl", exist_ok=True)
+    svc, _clock = build_shard_service(0, n_cqs, f"{tmp}/ctl")
+    return svc
+
+
+def _ctl_submit(svc, step, n_sub, per_step, n_cqs):
+    for j in range(n_sub):
+        for b in step_payloads(step, j, n_sub, per_step, n_cqs):
+            svc.submit(name=b["name"], queue_name=b["queue_name"],
+                       requests=b["requests"], priority=b["priority"],
+                       namespace=b["namespace"], runtime_s=b["runtime_s"],
+                       count=b["count"], token=b["token"])
+
+
+def _lockstep(subs, clients, ctl_svc, step, cfg):
+    """One barrier: submit, step every shard, replay into the control;
+    returns (dist keys, ctl keys) for this step, each sorted."""
+    _cmd_all(subs, f"step {step}")
+    _ctl_submit(ctl_svc, step, len(subs), cfg["per_step"], cfg["cqs"])
+    got = []
+    for c in clients:
+        st = c.step(retry_deadline_s=15.0)
+        for dec in st["decisions"]:
+            got.extend(dec)
+    ctl = ctl_svc.step()
+    want = [k for dec in ctl["decisions"] for k in dec]
+    return sorted(got), sorted(want)
+
+
+def _loss_dup(dist_keys: list, ctl_keys: list) -> tuple[int, int]:
+    """Multiset compare of every admission key across the arm: keys
+    the control admitted but the dist run lost, and keys the dist run
+    admitted more often than the control (a double admission)."""
+    d, c = Counter(dist_keys), Counter(ctl_keys)
+    lost = sum((c - d).values())
+    duplicated = sum((d - c).values())
+    return lost, duplicated
+
+
+def _merge_reports(reports: dict) -> dict:
+    """Sum the per-arm supervisor reports into the artifact's dist
+    block (spawns/kills/restarts by role, kill log tagged by arm)."""
+    by_role: dict[str, dict[str, int]] = {}
+    kill_log = []
+    for arm, rep in reports.items():
+        for role, st in rep["by_role"].items():
+            per = by_role.setdefault(
+                role, {"spawns": 0, "kills": 0, "restarts": 0})
+            for k, v in st.items():
+                per[k] += v
+        kill_log.extend(f"{arm}:{name}" for name in rep["kill_log"])
+    return {"by_role": by_role, "kill_log": kill_log,
+            "per_arm": reports}
+
+
+# ---------------------------------------------------------------------------
+# saturation
+# ---------------------------------------------------------------------------
+
+def arm_saturation(cfg, seed, td):
+    """Wall-clock admissions/s ceiling: blast rounds double until the
+    measured end-to-end rate (accept over HTTP + drain through real
+    step cycles) stops improving by >5%."""
+    tmp = f"{td}/sat"
+    os.makedirs(tmp, exist_ok=True)
+    sup = ProcessSupervisor(seed=seed)
+    rounds = []
+    try:
+        shards = _spawn_shards(sup, tmp, cfg["shards"], cfg["cqs"])
+        ports = [mp.port for mp in shards]
+        clients = [ShardClient(p) for p in ports]
+        subs = _spawn_submitters(sup, tmp, cfg["submitters"],
+                                 cfg["per_step"], cfg["cqs"], ports)
+        n = cfg["sat_base"]
+        best = 0.0
+        for r in range(cfg["sat_max_rounds"]):
+            t0 = time.monotonic()
+            replies = _cmd_all(subs, f"blast {n}")
+            accepted = sum(int(rep.split()[2]) for rep in replies)
+            # drain: real step cycles until every accept is admitted
+            steps = 0
+            while steps < cfg["sat_drain_cap"]:
+                stats = [c.svc_stats() for c in clients]
+                if (sum(s["admitted"] for s in stats)
+                        >= sum(s["accepted"] for s in stats)):
+                    break
+                for c in clients:
+                    c.step(retry_deadline_s=15.0)
+                steps += 1
+            elapsed = time.monotonic() - t0
+            stats = [c.svc_stats() for c in clients]
+            drained = (sum(s["admitted"] for s in stats)
+                       == sum(s["accepted"] for s in stats))
+            rate = accepted / elapsed if elapsed > 0 else 0.0
+            rounds.append({"n_per_submitter": n, "accepted": accepted,
+                           "drain_steps": steps, "elapsed_s": elapsed,
+                           "admissions_per_s": rate, "drained": drained})
+            log(f"  saturation round {r}: n={n} adm/s={rate:.1f} "
+                f"drain_steps={steps}")
+            if not drained or (best > 0 and rate < best * 1.05):
+                break
+            best = max(best, rate)
+            n *= 2
+        depths = {str(i): c.svc_stats()["ingest_depth"]
+                  for i, c in enumerate(clients)}
+        rep = sup.report()
+    finally:
+        sup.terminate_all()
+    ceiling = max((r["admissions_per_s"] for r in rounds), default=0.0)
+    return {
+        "wall_clock": True,
+        "rounds": rounds,
+        "ceiling_admissions_per_s": ceiling,
+        "submitter_procs": cfg["submitters"],
+        "shard_procs": cfg["shards"],
+        "shard_depths": depths,
+        "ok": ceiling > 0 and all(r["drained"] for r in rounds),
+    }, rep
+
+
+# ---------------------------------------------------------------------------
+# kill arms
+# ---------------------------------------------------------------------------
+
+def arm_kill_front_end_shard(cfg, seed, td):
+    """SIGKILL shard0 at a lockstep barrier via the armed ``dist.kill``
+    site; rebuild it from IngestJournal + CycleWAL on the same port,
+    resync the submitters, keep stepping — decisions bit-identical."""
+    tmp = f"{td}/kshard"
+    os.makedirs(tmp, exist_ok=True)
+    sup = ProcessSupervisor(seed=seed)
+    dist_keys, ctl_keys, per_step_ok = [], [], []
+    try:
+        shards = _spawn_shards(sup, tmp, cfg["shards"], cfg["cqs"])
+        ports = [mp.port for mp in shards]
+        clients = [ShardClient(p) for p in ports]
+        subs = _spawn_submitters(sup, tmp, cfg["submitters"],
+                                 cfg["per_step"], cfg["cqs"], ports)
+        ctl_svc = _control(tmp, cfg["cqs"])
+        half = cfg["kill_steps"] // 2
+        for s in range(half):
+            got, want = _lockstep(subs, clients, ctl_svc, s, cfg)
+            dist_keys += got
+            ctl_keys += want
+            per_step_ok.append(got == want)
+
+        inj = ChaosInjector(seed=seed)
+        inj.arm("dist.kill", at=1, payload="shard0")
+        chaos.install(inj)
+        killed = sup.maybe_kill("shard0")
+        chaos.clear()
+
+        argv, _ = _shard_argv(tmp, 0, cfg["cqs"], recover=True,
+                              resume_cycle=half, port=ports[0])
+        sup.restart("shard0", argv=argv)
+        same_port = shards[0].port == ports[0]
+        # replay the whole delivered schedule; every replay dedupes
+        replies = _cmd_all(subs, f"resync {half}")
+        deduped = sum(int(r.split()[2]) for r in replies)
+        expected_dedupes = len(subs) * half * cfg["per_step"]
+
+        for s in range(half, cfg["kill_steps"]):
+            got, want = _lockstep(subs, clients, ctl_svc, s, cfg)
+            dist_keys += got
+            ctl_keys += want
+            per_step_ok.append(got == want)
+        rep = sup.report()
+    finally:
+        sup.terminate_all()
+    lost, duplicated = _loss_dup(dist_keys, ctl_keys)
+    identical = all(per_step_ok)
+    return {
+        "killed": bool(killed), "same_port": same_port,
+        "steps": cfg["kill_steps"], "admissions": len(ctl_keys),
+        "decisions_identical": identical, "parity": identical,
+        "lost": lost, "duplicated": duplicated,
+        "dedupe": {"replayed": deduped, "expected": expected_dedupes},
+        "restarts": rep["by_role"]["shard"]["restarts"],
+        "ok": (killed and same_port and identical and lost == 0
+               and duplicated == 0 and deduped == expected_dedupes),
+    }, rep
+
+
+def arm_kill_submitter(cfg, seed, td):
+    """SIGKILL one submitter process mid-run; the respawn replays its
+    deterministic schedule from zero and every delivered submission
+    dedupes — the shards admit nothing twice."""
+    tmp = f"{td}/ksub"
+    os.makedirs(tmp, exist_ok=True)
+    sup = ProcessSupervisor(seed=seed)
+    dist_keys, ctl_keys, per_step_ok = [], [], []
+    try:
+        shards = _spawn_shards(sup, tmp, cfg["shards"], cfg["cqs"])
+        ports = [mp.port for mp in shards]
+        clients = [ShardClient(p) for p in ports]
+        subs = _spawn_submitters(sup, tmp, cfg["submitters"],
+                                 cfg["per_step"], cfg["cqs"], ports)
+        ctl_svc = _control(tmp, cfg["cqs"])
+        half = cfg["kill_steps"] // 2
+        for s in range(half):
+            got, want = _lockstep(subs, clients, ctl_svc, s, cfg)
+            dist_keys += got
+            ctl_keys += want
+            per_step_ok.append(got == want)
+
+        inj = ChaosInjector(seed=seed)
+        inj.arm("dist.kill", at=1, payload="sub0")
+        chaos.install(inj)
+        killed = sup.maybe_kill("sub0")
+        chaos.clear()
+
+        # respawn with the SAME identity (submitter_id 0 of N): the
+        # deterministic schedule it replays must be the one it owned
+        sub0 = _spawn_submitter(sup, 0, cfg["submitters"],
+                                cfg["per_step"], cfg["cqs"], ports)
+        subs[0] = sub0
+        deduped = int(_cmd(sub0, f"resync {half}").split()[2])
+        expected_dedupes = half * cfg["per_step"]
+
+        for s in range(half, cfg["kill_steps"]):
+            got, want = _lockstep(subs, clients, ctl_svc, s, cfg)
+            dist_keys += got
+            ctl_keys += want
+            per_step_ok.append(got == want)
+        rep = sup.report()
+    finally:
+        sup.terminate_all()
+    lost, duplicated = _loss_dup(dist_keys, ctl_keys)
+    identical = all(per_step_ok)
+    return {
+        "killed": bool(killed), "steps": cfg["kill_steps"],
+        "admissions": len(ctl_keys),
+        "decisions_identical": identical, "parity": identical,
+        "lost": lost, "duplicated": duplicated,
+        "dedupe": {"replayed": deduped, "expected": expected_dedupes},
+        "restarts": rep["by_role"]["submitter"]["kills"],
+        "ok": (killed and identical and lost == 0 and duplicated == 0
+               and deduped == expected_dedupes),
+    }, rep
+
+
+def arm_kill_service_mid_cycle(cfg, seed, td):
+    """The service process dies *inside* ``/admin/step`` at its own
+    armed ``svc.cycle`` crashpoint (exit 17, no cleanup); recovery
+    from the journals plus a re-issued step lands on the control's
+    exact decisions."""
+    tmp = f"{td}/ksvc"
+    os.makedirs(tmp, exist_ok=True)
+    sup = ProcessSupervisor(seed=seed)
+    dist_keys, ctl_keys, per_step_ok = [], [], []
+    crashes = 0
+    crash_exit = None
+    try:
+        argv, pf = _shard_argv(tmp, 0, cfg["cqs"],
+                               crash_site="svc.cycle", crash_at=2)
+        mp = sup.spawn("shard0", "shard", argv, port_file=pf)
+        sup.wait_ready(mp)
+        port = mp.port
+        ctl_svc = _control(tmp, cfg["cqs"])
+        client = ShardClient(port)
+        for s in range(cfg["kill_steps"]):
+            for b in step_payloads(s, 0, 1, cfg["per_step"], cfg["cqs"]):
+                client.submit(b, retry_deadline_s=5.0)
+            _ctl_submit(ctl_svc, s, 1, cfg["per_step"], cfg["cqs"])
+            try:
+                st = client.step()
+            except Exception:
+                mp.proc.wait(timeout=10)
+                crash_exit = mp.proc.returncode
+                crashes += 1
+                argv, _ = _shard_argv(tmp, 0, cfg["cqs"], recover=True,
+                                      resume_cycle=s, port=port)
+                sup.restart("shard0", argv=argv)
+                st = client.step(retry_deadline_s=10.0)
+            got = sorted(k for dec in st["decisions"] for k in dec)
+            ctl = ctl_svc.step()
+            want = sorted(k for dec in ctl["decisions"] for k in dec)
+            dist_keys += got
+            ctl_keys += want
+            per_step_ok.append(got == want)
+        rep = sup.report()
+    finally:
+        sup.terminate_all()
+    lost, duplicated = _loss_dup(dist_keys, ctl_keys)
+    identical = all(per_step_ok)
+    return {
+        "crashes": crashes, "crash_exit": crash_exit,
+        "steps": cfg["kill_steps"], "admissions": len(ctl_keys),
+        "decisions_identical": identical, "parity": identical,
+        "lost": lost, "duplicated": duplicated,
+        "restarts": rep["by_role"]["shard"]["restarts"],
+        "ok": (crashes == 1 and crash_exit == 17 and identical
+               and lost == 0 and duplicated == 0),
+    }, rep
+
+
+def arm_kill_federation_worker(cfg, seed, td):
+    """SIGKILL a federation worker process behind a fault-injecting
+    socket proxy; its journal rebuild + fresh-watch-epoch resync over
+    the real wire keep every digest bit-identical to the in-process
+    FederationSim control — while the proxy's seeded resets, latency,
+    and an armed truncate chew on the manager's RPCs."""
+    from kueue_tpu.federation.procs import ProcFederation, fed_traffic
+    from kueue_tpu.federation.sim import FederationSim, FedSpec
+    from kueue_tpu.remote import state_digest
+    tmp = f"{td}/kfed"
+    os.makedirs(tmp, exist_ok=True)
+    n_cqs, remote_cqs = cfg["fed_cqs"], cfg["fed_remote_cqs"]
+    sup = ProcessSupervisor(seed=seed)
+    proxies = []
+    try:
+        def worker_argv(name, recover=False, resume_t=None, port=0):
+            pf = f"{tmp}/{name}.port"
+            return child_argv(
+                "worker", name=name, remote_cqs=remote_cqs,
+                state_dir=tmp, port_file=pf, recover=recover,
+                resume_t=resume_t, port=port), pf
+
+        names = [f"w{i}" for i in range(cfg["workers"])]
+        workers = {}
+        for name in names:
+            argv, pf = worker_argv(name)
+            workers[name] = sup.spawn(name, "worker", argv, port_file=pf)
+        for mp in workers.values():
+            sup.wait_ready(mp)
+
+        # wire faults: a seeded probability plan plus one armed
+        # truncate — retries and the epoch probe must absorb them all
+        inj = ChaosInjector(seed=seed)
+        inj.arm("dist.proxy_fault", at=3, action="truncate", payload=16)
+        inj.arm("dist.proxy_fault", at=9, action="reset")
+        chaos.install(inj)
+        plan = FaultPlan.resolved(reset=cfg["proxy_reset"],
+                                  latency=cfg["proxy_latency"],
+                                  latency_s=0.02)
+        urls = {}
+        for name, mp in workers.items():
+            px = SocketFaultProxy(mp.port, seed=seed, plan=plan)
+            px.start()
+            proxies.append(px)
+            urls[name] = px.base_url
+
+        traffic = fed_traffic(steps=cfg["fed_traffic_steps"],
+                              per_step=2, n_cqs=n_cqs)
+        fed = ProcFederation(urls, n_cqs=n_cqs, remote_cqs=remote_cqs,
+                             client_timeout=2.0, client_retries=4)
+        fed.load_traffic(traffic)
+        spec = FedSpec(n_workers=cfg["workers"], n_cqs=n_cqs,
+                       remote_cqs=remote_cqs, manager_quota_m=8000,
+                       worker_quota_m=4000, runtime_steps=2,
+                       worker_lost_timeout=3.0, reconnect_budget=0)
+        ctl = FederationSim(spec, wal_dir=f"{tmp}/ctl")
+        ctl.load_traffic(dict(traffic))
+
+        pre = cfg["fed_pre_kill_steps"]
+        for _ in range(pre):
+            fed.step()
+            ctl.step()
+
+        port0 = workers["w0"].port
+        inj.arm("dist.kill", at=1, payload="w0")
+        killed = sup.maybe_kill("w0")
+        argv, _ = worker_argv("w0", recover=True, resume_t=fed.clock.t,
+                              port=port0)
+        sup.restart("w0", argv=argv)
+
+        for _ in range(cfg["fed_post_kill_steps"]):
+            fed.step()
+            ctl.step()
+
+        dg = fed.digests()
+        worker_parity = all(
+            dg["workers"][n] == state_digest(ctl.workers[n])
+            for n in urls)
+        manager_parity = dg["manager"] == state_digest(ctl.manager)
+        settled = fed.settled() and ctl.settled()
+        cl_stats = fed.client_stats()
+        resyncs = cl_stats["w0"]["epoch_resyncs"]
+        proxy_stats = Counter()
+        for px in proxies:
+            proxy_stats.update(px.stats)
+
+        # feed the distributed counters through Driver.stats so the
+        # kueue_dist_* / kueue_rpc_* series sample from a live run
+        fed.manager.rpc_clients = list(fed.clients.values())
+        fed.manager.dist_stats = {
+            "by_role": sup.stats, "proxy": dict(proxy_stats),
+            "shard_depths": {}}
+        mstats = fed.manager.stats
+        rep = sup.report()
+        unfinished = sum(1 for wl in fed.manager.workloads.values()
+                         if not wl.is_finished)
+        duplicated = len(fed.violations) + len(ctl.violations)
+    finally:
+        chaos.clear()
+        for px in proxies:
+            px.stop()
+        sup.terminate_all()
+    parity = manager_parity and worker_parity
+    return {
+        "killed": bool(killed),
+        "steps": pre + cfg["fed_post_kill_steps"],
+        "manager_parity": manager_parity,
+        "worker_parity": worker_parity,
+        "decisions_identical": parity, "parity": parity,
+        "settled": settled,
+        "lost": 0 if settled else unfinished,
+        "duplicated": duplicated,
+        "epoch_resyncs": resyncs,
+        "client_stats": cl_stats,
+        "proxy": dict(proxy_stats),
+        "restarts": rep["by_role"]["worker"]["restarts"],
+        "metrics": {"rpc": mstats.get("rpc"), "dist": mstats.get("dist")},
+        "ok": (killed and parity and settled and duplicated == 0
+               and resyncs >= 1),
+    }, rep
+
+
+# ---------------------------------------------------------------------------
+# socket faults
+# ---------------------------------------------------------------------------
+
+def arm_socket_faults(cfg, seed, td):
+    """Classification checks against a live worker process: refused vs
+    mid-body vs timeout, counted separately, epoch probed behind the
+    truncate."""
+    import socket as _socket
+
+    from kueue_tpu.remote import ConnectionLost, HttpWorkerClient
+    tmp = f"{td}/sock"
+    os.makedirs(tmp, exist_ok=True)
+    sup = ProcessSupervisor(seed=seed)
+    px = None
+    try:
+        pf = f"{tmp}/w0.port"
+        argv = child_argv("worker", name="w0", remote_cqs=2,
+                          state_dir=tmp, port_file=pf)
+        mp = sup.spawn("w0", "worker", argv, port_file=pf)
+        sup.wait_ready(mp)
+
+        inj = ChaosInjector(seed=seed)
+        inj.arm("dist.proxy_fault", at=2, action="reset")
+        inj.arm("dist.proxy_fault", at=4, action="truncate", payload=16)
+        inj.arm("dist.proxy_fault", at=6, action="latency", payload=0.2)
+        inj.arm("dist.proxy_fault", at=8, action="blackhole")
+        chaos.install(inj)
+        px = SocketFaultProxy(mp.port, seed=seed)
+        px.start()
+        cl = HttpWorkerClient(px.base_url, timeout=1.0, retries=4,
+                              backoff_base=0.01, backoff_max=0.05,
+                              deadline_s=10.0)
+        for _ in range(10):
+            cl.admin_status()   # retries absorb every armed fault
+        survived = True
+        chaos.clear()
+
+        # nothing listening: pure connect-refused classification
+        s = _socket.socket()
+        s.bind(("127.0.0.1", 0))
+        dead_port = s.getsockname()[1]
+        s.close()
+        cl2 = HttpWorkerClient(f"http://127.0.0.1:{dead_port}",
+                               timeout=1.0, retries=2, backoff_base=0.01,
+                               backoff_max=0.02, deadline_s=5.0)
+        refused_kind = None
+        try:
+            cl2.admin_status()
+        except ConnectionLost as e:
+            refused_kind = e.kind
+        rep = sup.report()
+    finally:
+        chaos.clear()
+        if px is not None:
+            px.stop()
+        sup.terminate_all()
+    ok = (survived and px.stats["resets"] == 1
+          and px.stats["truncations"] == 1
+          and px.stats["latencies"] == 1
+          and px.stats["blackholes"] == 1
+          and cl.stats["midbody_retries"] >= 1
+          and cl.stats["retries"] >= 3
+          and refused_kind == "refused"
+          and cl2.stats["refused_retries"] == 2)
+    return {
+        "proxy": dict(px.stats),
+        "client": dict(cl.stats),
+        "refused_kind": refused_kind,
+        "refused_retries": cl2.stats["refused_retries"],
+        "ok": ok,
+    }, rep
+
+
+# ---------------------------------------------------------------------------
+# main
+# ---------------------------------------------------------------------------
+
+def main() -> int:
+    ap = argparse.ArgumentParser(description=__doc__)
+    ap.add_argument("--seed", type=int,
+                    default=env_int("KUEUE_TPU_DIST_SEED"))
+    ap.add_argument("--shards", type=int,
+                    default=env_int("KUEUE_TPU_DIST_SHARDS"))
+    ap.add_argument("--submitters", type=int,
+                    default=env_int("KUEUE_TPU_DIST_SUBMITTERS"))
+    ap.add_argument("--workers", type=int,
+                    default=env_int("KUEUE_TPU_DIST_WORKERS"))
+    ap.add_argument("--quick", action="store_true",
+                    help="CI-sized: small blasts, short lockstep arms")
+    ap.add_argument("--out", default="DIST_r20.json")
+    args = ap.parse_args()
+
+    cfg = {
+        "cqs": 8,
+        "shards": max(2, args.shards),
+        "submitters": max(2, args.submitters),
+        "workers": max(2, args.workers),
+        "per_step": 3 if args.quick else 4,
+        "kill_steps": 4 if args.quick else 8,
+        "sat_base": 16 if args.quick else 48,
+        "sat_max_rounds": 2 if args.quick else 5,
+        "sat_drain_cap": 400,
+        "fed_cqs": 6,
+        "fed_remote_cqs": 4,
+        "fed_traffic_steps": 3 if args.quick else 5,
+        "fed_pre_kill_steps": 3,
+        "fed_post_kill_steps": 4 if args.quick else 7,
+        "proxy_reset": 0.03,
+        "proxy_latency": 0.05,
+    }
+    seed = args.seed
+    t0 = time.perf_counter()
+    reports = {}
+    with tempfile.TemporaryDirectory() as td:
+        log(f"dist soak: seed={seed} shards={cfg['shards']} "
+            f"submitters={cfg['submitters']} workers={cfg['workers']} "
+            f"quick={args.quick}")
+        log("arm: saturation")
+        saturation, reports["saturation"] = arm_saturation(cfg, seed, td)
+        log(f"  ceiling={saturation['ceiling_admissions_per_s']:.1f}/s "
+            f"ok={saturation['ok']}")
+        log("arm: kill front_end_shard")
+        k_shard, reports["front_end_shard"] = arm_kill_front_end_shard(
+            cfg, seed + 1, td)
+        log(f"  parity={k_shard['parity']} lost={k_shard['lost']} "
+            f"dup={k_shard['duplicated']}")
+        log("arm: kill submitter")
+        k_sub, reports["submitter"] = arm_kill_submitter(cfg, seed + 2, td)
+        log(f"  parity={k_sub['parity']} lost={k_sub['lost']} "
+            f"dup={k_sub['duplicated']}")
+        log("arm: kill service_mid_cycle")
+        k_svc, reports["service_mid_cycle"] = arm_kill_service_mid_cycle(
+            cfg, seed + 3, td)
+        log(f"  parity={k_svc['parity']} crashes={k_svc['crashes']} "
+            f"exit={k_svc['crash_exit']}")
+        log("arm: kill federation_worker")
+        k_fed, reports["federation_worker"] = arm_kill_federation_worker(
+            cfg, seed + 4, td)
+        log(f"  parity={k_fed['parity']} settled={k_fed['settled']} "
+            f"epoch_resyncs={k_fed['epoch_resyncs']}")
+        log("arm: socket_faults")
+        sock, reports["socket_faults"] = arm_socket_faults(
+            cfg, seed + 5, td)
+        log(f"  ok={sock['ok']} client={sock['client']}")
+
+    kills = {"submitter": k_sub, "front_end_shard": k_shard,
+             "service_mid_cycle": k_svc, "federation_worker": k_fed}
+    all_ok = (saturation["ok"] and sock["ok"]
+              and all(arm["ok"] for arm in kills.values()))
+    art = {
+        "metric": "dist_soak_saturation_admissions_per_s",
+        "unit": "admissions/s",
+        "value": saturation["ceiling_admissions_per_s"],
+        "seed": seed,
+        "quick": bool(args.quick),
+        "config": cfg,
+        "saturation": saturation,
+        "kills": kills,
+        "socket_faults": sock,
+        "dist": _merge_reports(reports),
+        "metrics": k_fed.pop("metrics"),
+        "all_ok": all_ok,
+        "elapsed_s": time.perf_counter() - t0,
+    }
+    with open(args.out, "w") as fh:
+        json.dump(art, fh, indent=1, sort_keys=True)
+    log(f"wrote {args.out} (all_ok={all_ok}, {art['elapsed_s']:.1f}s)")
+    return 0 if all_ok else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -948,11 +948,76 @@ def _check_serve(d, path, out):
         _err(out, path, "missing numeric 'elapsed_s'")
 
 
+def _check_dist(d, path, out):
+    """DIST_* distributed-soak artifacts (scripts/dist_soak.py): a
+    wall-clock saturation search across >= 2 submitter and >= 2 shard
+    processes, four process-kill arms (submitter, front-end shard,
+    service mid-cycle, federation worker) each recovering with zero
+    lost and zero duplicated admissions and decisions bit-identical
+    to a single-process control, plus socket-fault classification."""
+    sat = d.get("saturation")
+    if not isinstance(sat, dict):
+        _err(out, path, "missing 'saturation' block")
+        sat = {}
+    if sat.get("wall_clock") is not True:
+        _err(out, path, "'saturation.wall_clock' must be true (the "
+             "ceiling is a measured wall-clock rate)")
+    ceiling = sat.get("ceiling_admissions_per_s")
+    if not isinstance(ceiling, (int, float)) or ceiling <= 0:
+        _err(out, path, "missing positive numeric "
+             "'saturation.ceiling_admissions_per_s'")
+    for k in ("submitter_procs", "shard_procs"):
+        v = sat.get(k)
+        if not isinstance(v, int) or v < 2:
+            _err(out, path, f"'saturation.{k}'={v}: the distributed "
+                 "soak needs >= 2 real processes per role")
+    if not isinstance(sat.get("rounds"), list) or not sat["rounds"]:
+        _err(out, path, "'saturation.rounds' must be a non-empty list "
+             "(the search must show its measurements)")
+    kills = d.get("kills")
+    if not isinstance(kills, dict):
+        _err(out, path, "missing 'kills' block")
+        kills = {}
+    for arm in ("submitter", "front_end_shard", "service_mid_cycle",
+                "federation_worker"):
+        k = kills.get(arm)
+        if not isinstance(k, dict):
+            _err(out, path, f"missing 'kills.{arm}' arm")
+            continue
+        if k.get("parity") is not True:
+            _err(out, path, f"'kills.{arm}.parity' must be true "
+                 "against the single-process control")
+        if k.get("decisions_identical") is not True:
+            _err(out, path, f"'kills.{arm}.decisions_identical' must "
+                 "be true: recovery must be bit-identical")
+        if k.get("lost") != 0:
+            _err(out, path, f"'kills.{arm}.lost'={k.get('lost')}: "
+                 "a killed process must lose zero admissions")
+        if k.get("duplicated") != 0:
+            _err(out, path, f"'kills.{arm}.duplicated'="
+                 f"{k.get('duplicated')}: a killed process must "
+                 "duplicate zero admissions")
+    sock = d.get("socket_faults")
+    if not isinstance(sock, dict):
+        _err(out, path, "missing 'socket_faults' block")
+    elif sock.get("ok") is not True:
+        _err(out, path, "'socket_faults.ok' must be true: the client "
+             "must classify and survive every wire fault")
+    dist = d.get("dist")
+    if not isinstance(dist, dict):
+        _err(out, path, "missing 'dist' block (supervisor report)")
+    elif not dist.get("kill_log"):
+        _err(out, path, "'dist.kill_log' is empty: the kill arms must "
+             "record real SIGKILLs")
+    if not isinstance(d.get("elapsed_s"), (int, float)):
+        _err(out, path, "missing numeric 'elapsed_s'")
+
+
 # generator scripts that postdate the schema convention (metric+value
 # at top level); older BENCH_/MULTICHIP_r01-05 wrappers predate it and
 # only get the common checks
 _STRICT_PREFIXES = ("NORTHSTAR_", "CHAOS_", "TRAFFIC_", "SCALE_",
-                    "LINT_", "FED_", "OBS_", "SERVE_")
+                    "LINT_", "FED_", "OBS_", "SERVE_", "DIST_")
 
 
 def validate(path: str) -> list[str]:
@@ -993,6 +1058,10 @@ def validate(path: str) -> list[str]:
     # record even if the file was renamed
     if base.startswith("SERVE_") or ("kill_restart" in d and "wall" in d):
         _check_serve(d, path, out)
+    # by name or by shape: a kills+saturation pair marks a distributed
+    # soak record even if the file was renamed
+    if base.startswith("DIST_") or ("kills" in d and "saturation" in d):
+        _check_dist(d, path, out)
     # from r16 on, every NORTHSTAR/TRAFFIC/FED soak artifact must carry
     # the obs block (the telemetry plane rides every soak)
     rnd = re.match(r"(?:NORTHSTAR|TRAFFIC|FED)_R(\d+)", base)

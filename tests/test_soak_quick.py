@@ -151,6 +151,37 @@ def test_serve_soak_quick(tmp_path):
     assert _validate(out) == []
 
 
+def test_dist_soak_quick(tmp_path):
+    """The distributed control plane end to end at smoke scale: real
+    child processes under the seeded supervisor, a wall-clock
+    saturation round, all four process-kill arms recovering with zero
+    lost/duplicated admissions bit-identical to the single-process
+    control, and socket-fault classification through the proxy."""
+    out = str(tmp_path / "DIST_r99.json")
+    d = _run_quick("dist_soak.py", out)
+    assert d["quick"] is True
+    assert d["all_ok"] is True
+    assert d["saturation"]["wall_clock"] is True
+    assert d["saturation"]["ceiling_admissions_per_s"] > 0
+    assert d["saturation"]["submitter_procs"] >= 2
+    assert d["saturation"]["shard_procs"] >= 2
+    for arm in ("submitter", "front_end_shard", "service_mid_cycle",
+                "federation_worker"):
+        k = d["kills"][arm]
+        assert k["parity"] is True
+        assert k["decisions_identical"] is True
+        assert k["lost"] == 0
+        assert k["duplicated"] == 0
+    assert d["kills"]["service_mid_cycle"]["crash_exit"] == 17
+    assert d["kills"]["federation_worker"]["epoch_resyncs"] >= 1
+    assert d["socket_faults"]["ok"] is True
+    assert d["dist"]["kill_log"]
+    # the kueue_dist_* / kueue_rpc_* series sampled from the live run
+    assert d["metrics"]["rpc"]["requests"] > 0
+    assert d["metrics"]["dist"]["by_role"]["worker"]["kills"] == 1
+    assert _validate(out) == []
+
+
 def test_obs_soak_quick(tmp_path):
     """The telemetry plane end to end at smoke scale: interleaved
     traced/untraced arms on identically-built drivers, bit-identical

@@ -1,0 +1,211 @@
+"""Socket-fault proxy vs the HTTP worker client's retry machinery.
+
+The :class:`SocketFaultProxy` injects failure modes a mock transport
+can't produce honestly — hard RSTs, half-delivered bodies, blackholed
+reads, added wire latency — and these tests pin how
+``HttpWorkerClient`` classifies and survives each one: connect-refused
+retries within the deadline, mid-body failures resync the watch epoch
+before retrying, and both are counted separately in ``stats``."""
+
+from __future__ import annotations
+
+import socket
+
+import pytest
+
+from kueue_tpu.chaos import injector as chaos
+from kueue_tpu.controller.driver import Driver
+from kueue_tpu.dist.proxy import FaultPlan, SocketFaultProxy
+from kueue_tpu.dist.worker import worker_topology
+from kueue_tpu.remote import ConnectionLost, HttpWorkerClient, WorkerServer
+
+
+@pytest.fixture(autouse=True)
+def _chaos_off():
+    chaos.clear()
+    yield
+    chaos.clear()
+
+
+@pytest.fixture()
+def worker():
+    d = Driver()
+    worker_topology(2)(d)
+    srv = WorkerServer(d, admin=True)
+    srv.start()
+    yield srv
+    srv.stop()
+
+
+def _client(base_url, **kw):
+    defaults = dict(timeout=2.0, retries=4, backoff_base=0.01,
+                    backoff_max=0.05, deadline_s=8.0)
+    defaults.update(kw)
+    return HttpWorkerClient(base_url, **defaults)
+
+
+def test_armed_faults_fire_deterministically(worker):
+    """The ``dist.proxy_fault`` chaos site schedules wire faults by
+    hit count: reset at connection 2, truncate at 4 — the client
+    retries through both and every later call is clean."""
+    inj = chaos.ChaosInjector(seed=3)
+    inj.arm("dist.proxy_fault", at=2, action="reset")
+    inj.arm("dist.proxy_fault", at=4, action="truncate", payload=16)
+    chaos.install(inj)
+    px = SocketFaultProxy(worker.port, seed=3)
+    px.start()
+    try:
+        cl = _client(px.base_url)
+        for _ in range(6):
+            cl.admin_status()   # never raises: retries absorb faults
+        assert px.stats["resets"] == 1
+        assert px.stats["truncations"] == 1
+        assert cl.stats["retries"] >= 2
+        assert cl.stats["midbody_retries"] >= 1
+        assert cl.stats["deadline_exhausted"] == 0
+    finally:
+        px.stop()
+
+
+def test_latency_fault_within_timeout(worker):
+    """Added wire latency below the socket timeout is absorbed without
+    a retry — it burns budget, not correctness."""
+    inj = chaos.ChaosInjector(seed=3)
+    inj.arm("dist.proxy_fault", at=1, action="latency", payload=0.3)
+    chaos.install(inj)
+    px = SocketFaultProxy(worker.port, seed=3)
+    px.start()
+    try:
+        cl = _client(px.base_url)
+        assert cl.admin_status() == {}
+        assert px.stats["latencies"] == 1
+        assert cl.stats["retries"] == 0
+    finally:
+        px.stop()
+
+
+def test_blackhole_times_out_then_recovers(worker):
+    """A blackholed connection only ends at the client's socket
+    timeout; the retry lands on a clean connection."""
+    inj = chaos.ChaosInjector(seed=3)
+    inj.arm("dist.proxy_fault", at=1, action="blackhole")
+    chaos.install(inj)
+    px = SocketFaultProxy(worker.port, seed=3)
+    px.start()
+    try:
+        cl = _client(px.base_url, timeout=0.5)
+        assert cl.admin_status() == {}
+        assert px.stats["blackholes"] == 1
+        assert cl.stats["retries"] >= 1
+    finally:
+        px.stop()
+
+
+def test_connect_refused_classified_and_counted():
+    """Nothing listening: every attempt is a connect-refused retry,
+    surfaced as ConnectionLost(kind='refused') once the budget ends."""
+    s = socket.socket()
+    s.bind(("127.0.0.1", 0))
+    port = s.getsockname()[1]
+    s.close()
+    cl = _client(f"http://127.0.0.1:{port}", retries=2, timeout=1.0,
+                 deadline_s=2.0)
+    with pytest.raises(ConnectionLost) as ei:
+        cl.admin_status()
+    assert ei.value.kind == "refused"
+    assert cl.stats["refused_retries"] == 2
+    assert cl.stats["midbody_retries"] == 0
+    # refusals fail instantly, so the *retry* budget runs out well
+    # inside the 2 s time budget — deadline_exhausted stays clean
+    assert cl.stats["deadline_exhausted"] == 0
+
+
+def test_midbody_failure_probes_epoch_before_retry(worker):
+    """A half-delivered response on a *mutating* call triggers a watch
+    -epoch probe before the retry: if the worker restarted behind the
+    fault, the client counts the resync instead of trusting the old
+    stream."""
+    px = SocketFaultProxy(worker.port, seed=3)
+    px.start()
+    try:
+        cl = _client(px.base_url)
+        cl.set_clock(1000.0)   # learn the first epoch via retry path
+        assert cl._epoch is None   # probes only run on mid-body faults
+        inj = chaos.ChaosInjector(seed=3)
+        inj.arm("dist.proxy_fault", at=1, action="truncate", payload=16)
+        chaos.install(inj)
+        cl.set_clock(1001.0)   # truncated mid-body → probe + retry
+        assert cl.stats["midbody_retries"] >= 1
+        assert cl._epoch == worker.httpd.epoch
+        assert cl.stats["epoch_resyncs"] == 0   # same process, no lie
+    finally:
+        px.stop()
+
+
+def test_epoch_resync_detected_across_restart(worker):
+    """The probe's whole point: a mid-body fault hiding a worker
+    restart (fresh epoch) is detected and counted."""
+    px = SocketFaultProxy(worker.port, seed=3)
+    px.start()
+    try:
+        cl = _client(px.base_url)
+        cl._note_epoch(cl._probe_epoch())
+        first = cl._epoch
+        assert first == worker.httpd.epoch
+        # restart the worker on the same port, fresh epoch
+        d2 = Driver()
+        worker_topology(2)(d2)
+        worker.stop()
+        srv2 = WorkerServer(d2, port=worker.port, admin=True)
+        srv2.start()
+        try:
+            inj = chaos.ChaosInjector(seed=3)
+            inj.arm("dist.proxy_fault", at=1, action="truncate",
+                    payload=16)
+            chaos.install(inj)
+            cl.set_clock(1000.0)
+            assert cl._epoch == srv2.httpd.epoch != first
+            assert cl.stats["epoch_resyncs"] == 1
+        finally:
+            srv2.stop()
+    finally:
+        px.stop()
+
+
+def test_seeded_plan_is_reproducible(worker):
+    """Probability-plan faults come from the proxy's own seeded rng:
+    the same seed produces the same per-connection fault sequence."""
+    def run(seed):
+        plan = FaultPlan(reset=0.4)
+        px = SocketFaultProxy(worker.port, seed=seed, plan=plan)
+        px.start()
+        cl = _client(px.base_url, retries=6)
+        try:
+            for _ in range(10):
+                cl.admin_status()
+            return px.stats["resets"]
+        finally:
+            px.stop()
+    a, b = run(99), run(99)
+    assert a == b > 0
+
+
+def test_deadline_budget_exhausts_under_sustained_faults(worker):
+    """Sustained resets outlast the *time* budget: with retries to
+    spare, the client keeps backing off until the next backoff would
+    cross the deadline, then surfaces ConnectionLost and counts the
+    exhaustion instead of hanging forever."""
+    inj = chaos.ChaosInjector(seed=3)
+    inj.arm("dist.proxy_fault", at=1, times=50, action="reset")
+    chaos.install(inj)
+    px = SocketFaultProxy(worker.port, seed=3)
+    px.start()
+    try:
+        cl = _client(px.base_url, retries=50, timeout=1.0,
+                     deadline_s=1.5, backoff_base=0.2, backoff_max=0.2)
+        with pytest.raises(ConnectionLost):
+            cl.admin_status()
+        assert cl.stats["deadline_exhausted"] == 1
+        assert px.stats["resets"] >= 3
+    finally:
+        px.stop()
